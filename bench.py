@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Benchmark: DNS queries/sec + p50 resolve latency through the full stack.
+
+This is the BASELINE.md proxy metric — the reference publishes no numbers
+(BASELINE.json: "published": {}), so ``vs_baseline`` compares against the
+first locally measured value, persisted to ``BENCH_BASELINE.json``.
+
+Prints exactly ONE JSON line:
+    {"metric": "dns_queries_per_sec", "value": N, "unit": "qps",
+     "vs_baseline": R, "p50_us": ..., "p99_us": ...}
+
+Scenario (mirrors the reference's test/service.test.js hot path, SURVEY §3.2):
+a service record with multiple load-balancer children, resolved as
+round-robin A answers plus SRV lookups, via the in-process resolution engine
+over the fake coordination store — i.e. the same pure in-memory hot loop the
+reference serves from its ZK mirror.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    try:
+        from bench_impl import run_bench  # full-stack benchmark (added with the stack)
+        result = run_bench()
+    except Exception as e:  # stack not built yet / failed — report honestly
+        result = {
+            "metric": "dns_queries_per_sec",
+            "value": 0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

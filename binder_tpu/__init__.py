@@ -1,0 +1,30 @@
+"""binder_tpu — a from-scratch, capability-equivalent rebuild of
+TritonDataCenter/binder (reference mounted read-only at /root/reference).
+
+The reference is a service-discovery DNS server backed by a ZooKeeper-style
+coordination store (see /root/repo/SURVEY.md for the full structural
+analysis).  This package provides the rebuilt stack:
+
+- ``binder_tpu.dns``       — DNS wire codec + asyncio server engine
+                             (replaces the reference's external ``mname``
+                             npm dependency, SURVEY §7.1 step 1).
+- ``binder_tpu.store``     — coordination-store client interface, in-memory
+                             fake store, and the watch-driven mirror cache
+                             (port of ``lib/zk.js``).
+- ``binder_tpu.resolver``  — query resolution engine (port of
+                             ``lib/server.js``).
+- ``binder_tpu.recursion`` — best-effort cross-datacenter forwarder (port of
+                             ``lib/recursion.js``).
+- ``binder_tpu.metrics``   — Prometheus-style metric collectors + scrape
+                             server (artedi / triton-metrics analog).
+- ``binder_tpu.config``    — defaults ← JSON config file ← CLI flags merge
+                             (port of ``main.js`` option handling).
+- ``native/``              — C++ components mirroring the reference's C:
+                             load balancer (mname-balancer), instance-set
+                             reconciler (smf_adjust), txnlog decoder (zklog).
+
+Note (SURVEY §7.0): the reference contains no tensor/ML workload; this is a
+control-plane system measured on DNS queries/sec and resolve latency.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+"""Shared test configuration.
+
+The service tier is pure-Python asyncio; tests run against the in-memory
+fake coordination store (the reference's biggest testability gap — it has
+integration-only tests against a live ZooKeeper, SURVEY §4).
+
+JAX env pinning (harness requirement): any test that imports jax must see a
+CPU platform with a virtual 8-device mesh, never the real TPU tunnel.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
