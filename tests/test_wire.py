@@ -1,0 +1,271 @@
+"""Unit tests for the DNS wire codec (binder_tpu/dns/wire.py).
+
+The reference has no tests at this layer (it trusts the mname npm package);
+these tests are the protocol-level replacement for its dig(1) text-scraping
+(reference test/dig.js:109-134, SURVEY §4).
+"""
+import struct
+
+import pytest
+
+from binder_tpu.dns import (
+    AAAARecord,
+    ARecord,
+    CNAMERecord,
+    Message,
+    OPTRecord,
+    PTRRecord,
+    Question,
+    RawRecord,
+    Rcode,
+    SOARecord,
+    SRVRecord,
+    TXTRecord,
+    Type,
+    WireError,
+    ip_from_reverse_name,
+    make_query,
+    normalize_name,
+    reverse_name_for_ip,
+)
+from binder_tpu.dns.wire import decode_name, encode_name
+
+
+def roundtrip(msg: Message) -> Message:
+    return Message.decode(msg.encode())
+
+
+class TestNames:
+    def test_encode_decode_simple(self):
+        buf = bytearray()
+        encode_name("foo.example.com", buf)
+        name, off = decode_name(bytes(buf), 0)
+        assert name == "foo.example.com"
+        assert off == len(buf)
+
+    def test_normalization(self):
+        assert normalize_name("FoO.CoM.") == "foo.com"
+
+    def test_root_name(self):
+        buf = bytearray()
+        encode_name("", buf)
+        assert bytes(buf) == b"\x00"
+        assert decode_name(b"\x00", 0) == ("", 1)
+
+    def test_compression_shrinks_repeats(self):
+        offsets = {}
+        buf = bytearray(b"\x00" * 12)  # fake header
+        encode_name("a.foo.com", buf, offsets)
+        size_first = len(buf)
+        encode_name("b.foo.com", buf, offsets)
+        # second name should be label 'b' + 2-byte pointer = 1+1+2
+        assert len(buf) - size_first == 4
+        name, _ = decode_name(bytes(buf), size_first)
+        assert name == "b.foo.com"
+
+    def test_pointer_loop_rejected(self):
+        # pointer at offset 0 pointing to itself is a forward/self pointer
+        data = b"\xc0\x00"
+        with pytest.raises(WireError):
+            decode_name(data, 0)
+
+    def test_forward_pointer_rejected(self):
+        data = b"\xc0\x04\x00\x00\x01a\x00"
+        with pytest.raises(WireError):
+            decode_name(data, 0)
+
+    def test_label_too_long(self):
+        buf = bytearray()
+        with pytest.raises(WireError):
+            encode_name("a" * 64 + ".com", buf)
+
+    def test_name_too_long(self):
+        buf = bytearray()
+        with pytest.raises(WireError):
+            encode_name(".".join(["abcdefgh"] * 40), buf)
+
+    def test_truncated_label(self):
+        with pytest.raises(WireError):
+            decode_name(b"\x05ab", 0)
+
+
+class TestRecords:
+    def test_a_roundtrip(self):
+        msg = Message(id=7, qr=True, aa=True)
+        msg.questions.append(Question("host.foo.com", Type.A))
+        msg.answers.append(ARecord(name="host.foo.com", ttl=30,
+                                   address="10.0.0.1"))
+        out = roundtrip(msg)
+        assert out.id == 7 and out.qr and out.aa
+        assert out.answers[0].address == "10.0.0.1"
+        assert out.answers[0].ttl == 30
+        assert out.answers[0].name == "host.foo.com"
+
+    def test_aaaa_roundtrip(self):
+        msg = Message()
+        msg.answers.append(AAAARecord(name="h.foo.com", ttl=60,
+                                      address="fd00::1"))
+        out = roundtrip(msg)
+        assert out.answers[0].address == "fd00::1"
+
+    def test_srv_roundtrip(self):
+        msg = Message()
+        msg.answers.append(SRVRecord(name="_http._tcp.svc.foo.com", ttl=60,
+                                     priority=0, weight=10, port=8080,
+                                     target="h1.svc.foo.com"))
+        out = roundtrip(msg)
+        srv = out.answers[0]
+        assert (srv.priority, srv.weight, srv.port) == (0, 10, 8080)
+        assert srv.target == "h1.svc.foo.com"
+
+    def test_ptr_roundtrip(self):
+        msg = Message()
+        msg.answers.append(PTRRecord(name="1.0.0.10.in-addr.arpa", ttl=30,
+                                     target="host.foo.com"))
+        out = roundtrip(msg)
+        assert out.answers[0].target == "host.foo.com"
+
+    def test_soa_roundtrip(self):
+        msg = Message()
+        msg.authorities.append(SOARecord(
+            name="foo.com", ttl=60, mname="ns.foo.com",
+            rname="hostmaster.foo.com", serial=12, refresh=3600,
+            retry=600, expire=86400, minimum=60))
+        out = roundtrip(msg)
+        soa = out.authorities[0]
+        assert soa.mname == "ns.foo.com" and soa.serial == 12
+        assert soa.minimum == 60
+
+    def test_txt_roundtrip(self):
+        msg = Message()
+        msg.answers.append(TXTRecord(name="t.foo.com", ttl=5,
+                                     texts=("hello", "world")))
+        out = roundtrip(msg)
+        assert out.answers[0].texts == ("hello", "world")
+
+    def test_cname_roundtrip(self):
+        msg = Message()
+        msg.answers.append(CNAMERecord(name="www.foo.com", ttl=60,
+                                       target="host.foo.com"))
+        out = roundtrip(msg)
+        assert out.answers[0].target == "host.foo.com"
+
+    def test_unknown_type_kept_raw(self):
+        msg = Message()
+        msg.answers.append(RawRecord(name="x.foo.com", ttl=1,
+                                     rtype_code=99, rdata=b"\x01\x02"))
+        out = roundtrip(msg)
+        rec = out.answers[0]
+        assert isinstance(rec, RawRecord)
+        assert rec.rtype_code == 99 and rec.rdata == b"\x01\x02"
+
+    def test_multi_answer_compression(self):
+        """Round-robin responses repeat the qname — compression must engage."""
+        msg = Message(qr=True)
+        msg.questions.append(Question("svc.foo.com", Type.A))
+        for i in range(8):
+            msg.answers.append(ARecord(name="svc.foo.com", ttl=30,
+                                       address=f"10.0.0.{i + 1}"))
+        wire = msg.encode()
+        # uncompressed: each answer name alone would be 13 bytes; pointer is 2
+        assert len(wire) < 12 + 17 + 8 * (2 + 10 + 4) + 20
+        out = Message.decode(wire)
+        assert len(out.answers) == 8
+        assert {a.address for a in out.answers} == {
+            f"10.0.0.{i + 1}" for i in range(8)}
+
+
+class TestMessage:
+    def test_query_flags(self):
+        q = make_query("a.foo.com", Type.A, qid=1234, rd=True)
+        out = roundtrip(q)
+        assert out.id == 1234 and out.rd and not out.qr
+        assert out.questions[0].name == "a.foo.com"
+        assert out.questions[0].qtype == Type.A
+
+    def test_edns_payload(self):
+        q = make_query("a.foo.com", Type.A, edns_payload=1400)
+        out = roundtrip(q)
+        assert out.edns is not None
+        assert out.edns.udp_payload_size == 1400
+        assert out.max_udp_payload() == 1400
+
+    def test_no_edns_default_512(self):
+        q = make_query("a.foo.com", Type.A, edns_payload=None)
+        assert q.max_udp_payload() == 512
+
+    def test_rcode_roundtrip(self):
+        msg = Message(qr=True, rcode=Rcode.REFUSED)
+        out = roundtrip(msg)
+        assert out.rcode == Rcode.REFUSED
+
+    def test_truncation_sets_tc(self):
+        msg = Message(qr=True)
+        msg.questions.append(Question("svc.foo.com", Type.A))
+        for i in range(100):
+            msg.answers.append(ARecord(name="svc.foo.com", ttl=30,
+                                       address=f"10.0.{i // 250}.{i % 250}"))
+        wire = msg.encode(max_size=512)
+        assert len(wire) <= 512
+        out = Message.decode(wire)
+        assert out.tc and len(out.answers) == 0
+
+    def test_short_message_rejected(self):
+        with pytest.raises(WireError):
+            Message.decode(b"\x00\x01")
+
+    def test_garbage_counts_rejected(self):
+        hdr = struct.pack(">HHHHHH", 1, 0, 50, 0, 0, 0)
+        with pytest.raises(WireError):
+            Message.decode(hdr)
+
+
+class TestReverseNames:
+    def test_ipv4_reverse(self):
+        assert reverse_name_for_ip("10.1.2.3") == "3.2.1.10.in-addr.arpa"
+        assert ip_from_reverse_name("3.2.1.10.in-addr.arpa") == "10.1.2.3"
+
+    def test_ipv6_reverse_roundtrip(self):
+        name = reverse_name_for_ip("fd00::1")
+        assert name.endswith(".ip6.arpa")
+        assert ip_from_reverse_name(name) == "fd00::1"
+
+    def test_invalid_reverse_names(self):
+        # mirrors reference REFUSED cases (test/host.test.js:133-167)
+        assert ip_from_reverse_name("777.1.2.3.in-addr.arpa") is None
+        assert ip_from_reverse_name("2.3.4.in-addr.arpa") is None
+        assert ip_from_reverse_name("a.b.c.d.in-addr.arpa") is None
+        assert ip_from_reverse_name("host.foo.com") is None
+
+
+class TestReviewRegressions:
+    """Regressions from the first code-review pass."""
+
+    def test_ip6_arpa_multi_char_nibble_rejected(self):
+        name = "ab." + ".".join(["0"] * 31) + ".ip6.arpa"
+        assert ip_from_reverse_name(name) is None
+
+    def test_srv_target_past_rdlen_rejected(self):
+        msg = Message()
+        msg.answers.append(SRVRecord(name="s.foo.com", ttl=1, priority=0,
+                                     weight=0, port=1, target="t.foo.com"))
+        wire = bytearray(msg.encode())
+        # find the rdlen field and shrink it so the target overflows rdata
+        # header(12) + name + type/class/ttl(8) + rdlen(2)
+        name_len = len(b"\x01s\x03foo\x03com\x00")
+        rdlen_at = 12 + name_len + 8
+        struct.pack_into(">H", wire, rdlen_at, 7)
+        with pytest.raises(WireError):
+            Message.decode(bytes(wire))
+
+    def test_truncation_keeps_opt(self):
+        msg = Message(qr=True)
+        msg.questions.append(Question("svc.foo.com", Type.A))
+        msg.additionals.append(OPTRecord(name="", ttl=0,
+                                         udp_payload_size=1232))
+        for i in range(100):
+            msg.answers.append(ARecord(name="svc.foo.com", ttl=30,
+                                       address=f"10.0.0.{i % 250}"))
+        out = Message.decode(msg.encode(max_size=512))
+        assert out.tc and out.edns is not None
+        assert out.edns.udp_payload_size == 1232
