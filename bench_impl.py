@@ -1,0 +1,158 @@
+"""Full-stack DNS benchmark (invoked by bench.py).
+
+Measures the BASELINE.md proxy metric — DNS queries/sec and resolve-latency
+percentiles — end-to-end: real UDP datagrams through the transport engine,
+resolution engine, and mirror cache (the reference's hot path, SURVEY §3.2),
+using the in-memory fake store exactly where the reference would hit its
+in-memory ZK mirror.
+
+Query mix mirrors BASELINE.json's proxy configs: single-host A lookups,
+round-robin service A lookups, SRV lookups, and PTR lookups.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Dict, List
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "bench.com"
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "20000"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "32"))
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+
+
+def build_fixture() -> MirrorCache:
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/bench/web",
+                   {"type": "host", "host": {"address": "10.1.0.1"}})
+    store.put_json("/com/bench/svc", {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 8080},
+    })
+    for i in range(8):
+        store.put_json(f"/com/bench/svc/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.1.1.{i + 1}"}})
+    store.start_session()
+    return cache
+
+
+class BenchClient(asyncio.DatagramProtocol):
+    """Windowed UDP load generator: keeps CONCURRENCY queries in flight."""
+
+    def __init__(self, queries: List[bytes], done: asyncio.Future) -> None:
+        self.queries = queries
+        self.done = done
+        self.next_idx = 0
+        self.received = 0
+        self.latencies: List[float] = []
+        self.sent_at: Dict[int, float] = {}
+        self.errors = 0
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        for _ in range(min(CONCURRENCY, len(self.queries))):
+            self._send_next()
+
+    def _send_next(self) -> None:
+        i = self.next_idx
+        if i >= len(self.queries):
+            return
+        self.next_idx += 1
+        self.sent_at[i] = time.perf_counter()
+        self.transport.sendto(self.queries[i])
+
+    def datagram_received(self, data, addr) -> None:
+        now = time.perf_counter()
+        qid = int.from_bytes(data[:2], "big")
+        t0 = self.sent_at.pop(qid, None)
+        if t0 is not None:
+            self.latencies.append(now - t0)
+        msg = Message.decode(data)
+        if msg.rcode not in (Rcode.NOERROR,):
+            self.errors += 1
+        self.received += 1
+        if self.received >= len(self.queries):
+            if not self.done.done():
+                self.done.set_result(None)
+        else:
+            self._send_next()
+
+
+async def _bench() -> Dict[str, float]:
+    cache = build_fixture()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="dc0", host="127.0.0.1", port=0,
+                          collector=MetricsCollector())
+    await server.start()
+
+    mix = [
+        ("web.bench.com", Type.A),
+        ("svc.bench.com", Type.A),
+        ("_http._tcp.svc.bench.com", Type.SRV),
+        ("1.0.1.10.in-addr.arpa", Type.PTR),
+    ]
+    queries = [make_query(*mix[i % len(mix)], qid=i % 65536).encode()
+               for i in range(N_QUERIES)]
+
+    loop = asyncio.get_running_loop()
+    done = loop.create_future()
+    t0 = time.perf_counter()
+    transport, proto = await loop.create_datagram_endpoint(
+        lambda: BenchClient(queries, done),
+        remote_addr=("127.0.0.1", server.udp_port))
+    await asyncio.wait_for(done, timeout=120)
+    elapsed = time.perf_counter() - t0
+    transport.close()
+    await server.stop()
+
+    lats = sorted(proto.latencies)
+    qps = N_QUERIES / elapsed
+    return {
+        "qps": qps,
+        "elapsed_s": elapsed,
+        "errors": proto.errors,
+        "p50_us": lats[len(lats) // 2] * 1e6,
+        "p99_us": lats[int(len(lats) * 0.99)] * 1e6,
+    }
+
+
+def run_bench() -> Dict[str, object]:
+    res = asyncio.run(_bench())
+
+    baseline = None
+    if os.path.exists(BASELINE_FILE):
+        try:
+            with open(BASELINE_FILE) as f:
+                baseline = json.load(f).get("qps")
+        except (OSError, ValueError):
+            baseline = None
+    if not baseline:
+        # first measured value becomes the local baseline (the reference
+        # publishes no numbers — BASELINE.md)
+        with open(BASELINE_FILE, "w") as f:
+            json.dump({"qps": res["qps"],
+                       "note": "first local measurement; reference "
+                               "publishes no numbers (BASELINE.md)"}, f)
+        baseline = res["qps"]
+
+    return {
+        "metric": "dns_queries_per_sec",
+        "value": round(res["qps"], 1),
+        "unit": "qps",
+        "vs_baseline": round(res["qps"] / baseline, 3),
+        "p50_us": round(res["p50_us"], 1),
+        "p99_us": round(res["p99_us"], 1),
+        "errors": res["errors"],
+        "queries": N_QUERIES,
+        "concurrency": CONCURRENCY,
+    }

@@ -1,0 +1,126 @@
+"""Per-query context: request, response-under-construction, timers.
+
+The mname-equivalent query object handed to the resolution layer (reference
+mname's query, consumed at ``lib/server.js:471-507``).  Carries:
+
+- the decoded request and the response being assembled,
+- the client address (which for balancer-socket queries is the *original*
+  client, not the balancer — SURVEY §2.2 L1),
+- per-phase timers (reference ``query._stamp``, ``lib/server.js:476-483``),
+- the structured-log context dict.
+
+``respond()`` hands the finished response to the transport callback exactly
+once; the server engine then emits the ``after`` event for metrics/logging
+(reference ``lib/server.js:509-591``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from binder_tpu.dns.wire import (
+    Message,
+    Opcode,
+    Rcode,
+    Record,
+    Type,
+)
+
+
+class QueryCtx:
+    __slots__ = ("request", "response", "src", "protocol",
+                 "client_transport", "_send", "_responded", "bytes_sent",
+                 "start", "_last_stamp", "times", "log_ctx")
+
+    def __init__(self, request: Message,
+                 src: Tuple[str, int],
+                 protocol: str,
+                 send: Callable[[bytes], None],
+                 client_transport: Optional[str] = None) -> None:
+        self.request = request
+        self.src = src
+        self.protocol = protocol  # 'udp' | 'tcp' | 'balancer'
+        # For balancer queries: the transport the client used to reach the
+        # balancer ('udp'|'tcp') — decides truncation semantics.
+        self.client_transport = client_transport
+        self._send = send
+        self._responded = False
+        self.bytes_sent = 0
+        self.start = time.monotonic()
+        self._last_stamp = self.start
+        self.times: Dict[str, float] = {}
+        self.log_ctx: Dict[str, object] = {}
+
+        self.response = Message(
+            id=request.id, qr=True, opcode=request.opcode, aa=True,
+            rd=request.rd, ra=False, questions=list(request.questions))
+        opt = request.edns
+        if opt is not None:
+            # echo EDNS back with our payload ceiling
+            from binder_tpu.dns.wire import OPTRecord
+            self.response.additionals.append(
+                OPTRecord(name="", ttl=0, udp_payload_size=1232))
+
+    # -- request accessors --
+
+    def name(self) -> str:
+        return self.request.questions[0].name if self.request.questions else ""
+
+    def qtype(self) -> int:
+        return (self.request.questions[0].qtype
+                if self.request.questions else 0)
+
+    def qtype_name(self) -> str:
+        return Type.name(self.qtype())
+
+    def rd(self) -> bool:
+        return self.request.rd
+
+    # -- response construction (mname addAnswer/addAuthority/addAdditional) --
+
+    def set_error(self, rcode: int) -> None:
+        self.response.rcode = rcode
+
+    def rcode(self) -> int:
+        return self.response.rcode
+
+    def add_answer(self, record: Record) -> None:
+        self.response.answers.append(record)
+
+    def add_authority(self, record: Record) -> None:
+        self.response.authorities.append(record)
+
+    def add_additional(self, record: Record) -> None:
+        self.response.additionals.append(record)
+
+    # -- timers (lib/server.js:476-483) --
+
+    def stamp(self, name: str) -> None:
+        now = time.monotonic()
+        self.times[name] = (now - self._last_stamp) * 1000.0
+        self._last_stamp = now
+
+    def latency_ms(self) -> float:
+        return (time.monotonic() - self.start) * 1000.0
+
+    # -- completion --
+
+    def respond(self) -> None:
+        if self._responded:
+            return
+        udp_semantics = (self.protocol == "udp"
+                         or (self.protocol == "balancer"
+                             and self.client_transport != "tcp"))
+        # encode BEFORE marking responded: an encode failure must leave the
+        # fallback SERVFAIL path able to answer
+        if udp_semantics:
+            wire = self.response.encode(max_size=self.request.max_udp_payload())
+        else:
+            wire = self.response.encode()
+        self._responded = True
+        self.bytes_sent = len(wire)
+        self._send(wire)
+
+    @property
+    def responded(self) -> bool:
+        return self._responded

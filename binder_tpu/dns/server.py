@@ -1,0 +1,275 @@
+"""DNS server transport engine (the mname-equivalent, asyncio).
+
+Owns sockets and framing; knows nothing about resolution.  The binder layer
+(``binder_tpu.server``) attaches ``on_query`` / ``on_after`` hooks, exactly
+like the reference attaches handlers to mname's ``query``/``after`` events
+(``lib/server.js:471,509``).
+
+Listeners (reference ``lib/server.js:609-653``):
+- ``listen_udp``   — datagram per query, truncation per EDNS payload.
+- ``listen_tcp``   — RFC 1035 §4.2.2 two-byte length framing, many queries
+  per connection.
+- ``listen_balancer`` — UNIX-socket backend side of the balancer protocol
+  (docs/balancer-protocol.md) carrying original client addresses.
+
+Error tolerance: EHOSTUNREACH (asymmetric routing) is logged and swallowed
+(reference ``lib/server.js:593-607``); malformed packets get FORMERR when a
+query id is recoverable, else are dropped.
+"""
+from __future__ import annotations
+
+import asyncio
+import errno
+import ipaddress
+import logging
+import socket
+import struct
+from typing import Callable, List, Optional, Tuple
+
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.dns.wire import Message, Rcode, WireError
+
+BALANCER_VERSION = 1
+BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
+MAX_FRAME = 65_556
+TRANSPORT_UDP = 0
+TRANSPORT_TCP = 1
+
+
+def pack_balancer_frame(family: int, addr: str, port: int,
+                        payload: bytes,
+                        transport: int = TRANSPORT_UDP) -> bytes:
+    raw = (ipaddress.IPv4Address(addr).packed + b"\x00" * 12
+           if family == 4 else ipaddress.IPv6Address(addr).packed)
+    return struct.pack(">IBBB16sH", BALANCER_HDR + len(payload),
+                       BALANCER_VERSION, family, transport, raw,
+                       port) + payload
+
+
+def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
+    version, family, transport, raw, port = struct.unpack_from(
+        ">BBB16sH", frame, 0)
+    if version != BALANCER_VERSION:
+        raise WireError(f"unknown balancer protocol version {version}")
+    if transport not in (TRANSPORT_UDP, TRANSPORT_TCP):
+        raise WireError(f"bad transport {transport}")
+    if family == 4:
+        addr = str(ipaddress.IPv4Address(raw[:4]))
+    elif family == 6:
+        addr = str(ipaddress.IPv6Address(raw))
+    else:
+        raise WireError(f"bad address family {family}")
+    return family, addr, port, transport, frame[BALANCER_HDR:]
+
+
+class DnsServer:
+    def __init__(self, log: Optional[logging.Logger] = None,
+                 name: str = "binder") -> None:
+        self.log = log or logging.getLogger("binder.dns")
+        self.name = name
+        self.on_query: Optional[Callable] = None   # async (QueryCtx) -> None
+        self.on_after: Optional[Callable] = None   # sync  (QueryCtx) -> None
+        self._udp_transports: List[asyncio.DatagramTransport] = []
+        self._tcp_servers: List[asyncio.AbstractServer] = []
+        self._unix_servers: List[asyncio.AbstractServer] = []
+        self._tasks: set = set()
+
+    # -- shared query dispatch --
+    #
+    # The on_query hook is a *synchronous* callable returning either None
+    # (query fully handled — the cache-hit hot path, no task overhead) or
+    # an awaitable for work that needs real I/O (the recursion path),
+    # which is then driven by a task.
+
+    def _dispatch(self, request: Message, src: Tuple[str, int],
+                  protocol: str, send: Callable[[bytes], None],
+                  client_transport: Optional[str] = None) -> None:
+        query = QueryCtx(request, src, protocol, send,
+                         client_transport=client_transport)
+        if self.on_query is None:
+            query.set_error(Rcode.NOTIMP)
+            query.respond()
+            return
+        try:
+            pending = self.on_query(query)
+        except Exception as e:
+            self._on_query_error(query, e)
+            return
+        if pending is None:
+            self._after(query)
+            return
+        task = asyncio.ensure_future(self._run_async(query, pending))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_async(self, query: QueryCtx, pending) -> None:
+        try:
+            await pending
+        except Exception as e:
+            self._on_query_error(query, e)
+            return
+        self._after(query)
+
+    def _on_query_error(self, query: QueryCtx, e: Exception) -> None:
+        if isinstance(e, OSError) and e.errno == errno.EHOSTUNREACH:
+            # asymmetric routing — log and carry on (lib/server.js:593-607)
+            self.log.error("cannot reply to DNS traffic: "
+                           "is there asymmetric routing?")
+            return
+        self.log.error("query handler failed", exc_info=e)
+        if not query.responded:
+            # drop any half-built (possibly unencodable) answer set
+            query.response.answers.clear()
+            query.response.authorities.clear()
+            query.response.additionals.clear()
+            query.set_error(Rcode.SERVFAIL)
+            try:
+                query.respond()
+            except OSError:
+                pass
+
+    def _after(self, query: QueryCtx) -> None:
+        if self.on_after is not None and query.responded:
+            try:
+                self.on_after(query)
+            except Exception:
+                self.log.exception("after hook failed")
+
+    def _handle_raw(self, data: bytes, src: Tuple[str, int],
+                    protocol: str, send: Callable[[bytes], None],
+                    client_transport: Optional[str] = None) -> None:
+        try:
+            request = Message.decode(data)
+        except WireError as e:
+            self.log.debug("dropping malformed packet from %s: %s", src, e)
+            if len(data) >= 2:
+                qid = struct.unpack_from(">H", data, 0)[0]
+                resp = Message(id=qid, qr=True, rcode=Rcode.FORMERR)
+                try:
+                    send(resp.encode())
+                except OSError:
+                    pass
+            return
+        if request.qr:
+            return  # not a query
+        self._dispatch(request, src, protocol, send, client_transport)
+
+    # -- UDP --
+
+    async def listen_udp(self, address: str, port: int) -> int:
+        loop = asyncio.get_running_loop()
+        server = self
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                server._handle_raw(
+                    data, (addr[0], addr[1]), "udp",
+                    lambda wire, _addr=addr: self.transport.sendto(wire,
+                                                                   _addr))
+
+            def error_received(self, exc):
+                server.log.error("UDP socket error: %s", exc)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=(address, port))
+        self._udp_transports.append(transport)
+        actual = transport.get_extra_info("sockname")[1]
+        self.log.info("UDP DNS service started on %s:%d", address, actual)
+        return actual
+
+    # -- TCP (2-byte length framing, RFC 1035 §4.2.2) --
+
+    async def listen_tcp(self, address: str, port: int) -> int:
+        server = await asyncio.start_server(self._tcp_conn, address, port)
+        self._tcp_servers.append(server)
+        actual = server.sockets[0].getsockname()[1]
+        self.log.info("TCP DNS service started on %s:%d", address, actual)
+        return actual
+
+    async def _tcp_conn(self, reader: asyncio.StreamReader,
+                        writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        try:
+            while True:
+                hdr = await reader.readexactly(2)
+                (length,) = struct.unpack(">H", hdr)
+                data = await reader.readexactly(length)
+
+                def send(wire: bytes) -> None:
+                    writer.write(struct.pack(">H", len(wire)) + wire)
+
+                self._handle_raw(data, (peer[0], peer[1]), "tcp", send)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    # -- balancer backend socket (docs/balancer-protocol.md) --
+
+    async def listen_balancer(self, path: str) -> None:
+        server = await asyncio.start_unix_server(self._balancer_conn, path)
+        self._unix_servers.append(server)
+        self.log.info("balancer service started on %s", path)
+
+    async def _balancer_conn(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        lock = asyncio.Lock()
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (length,) = struct.unpack(">I", hdr)
+                if length < BALANCER_HDR or length > MAX_FRAME:
+                    self.log.error("balancer frame length %d out of range",
+                                   length)
+                    return
+                frame = await reader.readexactly(length)
+                try:
+                    family, addr, port, transport, payload = \
+                        unpack_balancer_frame(frame)
+                except WireError as e:
+                    self.log.error("balancer protocol error: %s", e)
+                    return
+
+                def send(wire: bytes, f=family, a=addr, p=port,
+                         t=transport) -> None:
+                    out = pack_balancer_frame(f, a, p, wire, transport=t)
+                    # serialize frame writes from concurrent queries
+                    async def _write():
+                        async with lock:
+                            writer.write(out)
+                            await writer.drain()
+                    asyncio.ensure_future(_write())
+
+                self._handle_raw(
+                    payload, (addr, port), "balancer", send,
+                    client_transport=("tcp" if transport == TRANSPORT_TCP
+                                      else "udp"))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    # -- lifecycle --
+
+    async def close(self) -> None:
+        for t in self._udp_transports:
+            t.close()
+        for s in self._tcp_servers + self._unix_servers:
+            s.close()
+            await s.wait_closed()
+        for task in list(self._tasks):
+            task.cancel()
+        self._udp_transports.clear()
+        self._tcp_servers.clear()
+        self._unix_servers.clear()

@@ -1,0 +1,157 @@
+"""Process entry point: config, metrics, store, recursion, server wiring.
+
+Port of the reference's ``main.js`` startup pipeline (``main.js:154-224``):
+
+    metrics server (port+1000) → store client + mirror cache → recursion
+    (optional) → balancer-socket SIGTERM handling → DNS server
+
+Run as:  python -m binder_tpu.main -f etc/config.json [-p port] [-v]
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Dict, Optional
+
+from binder_tpu.config.options import ConfigError, parse_options
+from binder_tpu.metrics.collector import MetricsCollector, MetricsServer
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.utils.jsonlog import log_event, make_logger
+
+NAME = "binder"
+
+
+def safe_unlink(path: str, log: logging.Logger) -> None:
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    except OSError as e:
+        log.warning("unlinking socket path %s: %s", path, e)
+
+
+def make_store(options: Dict[str, object], log: logging.Logger):
+    """Select the coordination-store backend from config."""
+    store_cfg = options.get("store") or {}
+    backend = store_cfg.get("backend", "zookeeper")
+    if backend == "fake":
+        store = FakeStore()
+        fixture = store_cfg.get("fixture")
+        if fixture:
+            import json
+            with open(fixture) as f:
+                for path, obj in json.load(f).items():
+                    store.put_json(path, obj)
+        store.start_session()
+        return store
+    if backend == "zookeeper":
+        try:
+            from binder_tpu.store.zk_client import ZKClient
+        except ImportError as e:
+            raise ConfigError(f"zookeeper store backend unavailable: {e}")
+        return ZKClient(
+            address=store_cfg.get("host",
+                                  os.environ.get("ZK_HOST", "127.0.0.1")),
+            port=int(store_cfg.get("port", 2181)),
+            session_timeout_ms=int(store_cfg.get("sessionTimeout", 30000)),
+            log=log,
+        )
+    raise ConfigError(f"unknown store backend: {backend}")
+
+
+async def run(options: Dict[str, object]) -> BinderServer:
+    log = make_logger(NAME, str(options.get("logLevel", os.environ.get(
+        "LOG_LEVEL", "info"))))
+    log_event(log, logging.INFO, "starting with options", options={
+        k: v for k, v in options.items() if k != "store"})
+
+    port = int(options["port"])
+    collector = MetricsCollector(static_labels={
+        "datacenter": options.get("datacenterName"),
+        "instance": options.get("instance_uuid"),
+        "server": options.get("server_uuid"),
+        "service": options.get("service_name"),
+        "port": port,
+    })
+    metrics = MetricsServer(collector, address="0.0.0.0",
+                            port=port + 1000 if port else 0)
+    metrics.start()
+    log.info("metrics server started on port %d", metrics.port)
+
+    store = make_store(options, log)
+    cache = MirrorCache(store, str(options["dnsDomain"]), log=log)
+
+    recursion = None
+    if options.get("recursion"):
+        try:
+            from binder_tpu.recursion import Recursion
+        except ImportError as e:
+            raise ConfigError(f"recursion unavailable: {e}")
+        rcfg = dict(options["recursion"])
+        recursion = Recursion(
+            zk_cache=cache, log=log,
+            region_name=rcfg.get("regionName", ""),
+            datacenter_name=str(options.get("datacenterName", "")),
+            dns_domain=str(options["dnsDomain"]),
+            ufds=rcfg.get("ufds") or {},
+        )
+        await recursion.wait_ready()
+
+    balancer_socket = options.get("balancerSocket")
+    if balancer_socket:
+        # clear any stale socket; unlink on SIGTERM so the balancer stops
+        # routing to us (main.js:181-199)
+        safe_unlink(str(balancer_socket), log)
+        loop = asyncio.get_running_loop()
+
+        def on_sigterm():
+            log.info("caught SIGTERM; unlinking socket %s", balancer_socket)
+            safe_unlink(str(balancer_socket), log)
+            sys.exit(0)
+
+        loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+
+    server = BinderServer(
+        zk_cache=cache,
+        dns_domain=str(options["dnsDomain"]),
+        datacenter_name=str(options.get("datacenterName", "")),
+        recursion=recursion,
+        log=log,
+        collector=collector,
+        name=NAME,
+        host=str(options.get("host", "0.0.0.0")),
+        port=port,
+        balancer_socket=str(balancer_socket) if balancer_socket else None,
+    )
+    await server.start()
+    log.info("done with binder init")
+    server.metrics = metrics  # keep a handle for shutdown
+    return server
+
+
+def main(argv=None) -> None:
+    try:
+        options = parse_options(argv)
+    except ConfigError as e:
+        print(e, file=sys.stderr)
+        sys.exit(1)
+
+    async def _run():
+        await run(options)
+        await asyncio.Event().wait()  # serve forever
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+    except ConfigError as e:
+        print(e, file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

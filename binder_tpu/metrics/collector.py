@@ -1,0 +1,181 @@
+"""Prometheus-style metric collectors + text exposition.
+
+Artedi/triton-metrics equivalent (reference ``lib/server.js:31-34,456-469``
+and ``main.js:134-152``), built on the stdlib only.  Provides the same
+three binder metrics with the same names:
+
+- ``binder_requests_completed``        counter,   labeled by qtype
+- ``binder_request_latency_seconds``   histogram, labeled by qtype
+- ``binder_response_size_bytes``       histogram, labeled by qtype
+
+plus a ``/metrics`` scrape endpoint served on service-port+1000 (the Triton
+convention, reference ``main.js:144-151``).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# artedi's default buckets are log-linear; these are the standard prometheus
+# client defaults, which cover the same DNS-latency range.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+DEFAULT_SIZE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help: str) -> None:
+        self.name = name
+        self.help = help
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def increment(self, labels: Optional[Dict[str, str]] = None,
+                  by: float = 1.0) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def expose(self, static: Tuple[Tuple[str, str], ...] = ()) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(static + key)} {v:g}")
+        return "\n".join(lines)
+
+
+class Histogram:
+    def __init__(self, name: str, help: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS) -> None:
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(_labels_key(labels), 0)
+
+    def expose(self, static: Tuple[Tuple[str, str], ...] = ()) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for key in sorted(self._counts):
+            counts = self._counts[key]
+            full = static + key
+            for i, b in enumerate(self.buckets):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(full, f'le=\"{b:g}\"')} {counts[i]}")
+            lines.append(f"{self.name}_bucket"
+                         f"{_fmt_labels(full, 'le=\"+Inf\"')} "
+                         f"{self._totals[key]}")
+            lines.append(f"{self.name}_sum{_fmt_labels(full)} "
+                         f"{self._sums[key]:g}")
+            lines.append(f"{self.name}_count{_fmt_labels(full)} "
+                         f"{self._totals[key]}")
+        return "\n".join(lines)
+
+
+class MetricsCollector:
+    """Registry of named collectors (artedi createCollector analog)."""
+
+    def __init__(self,
+                 static_labels: Optional[Dict[str, str]] = None) -> None:
+        self._collectors: Dict[str, object] = {}
+        self.static_labels = static_labels or {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        c = self._collectors.get(name)
+        if c is None:
+            c = Counter(name, help)
+            self._collectors[name] = c
+        return c  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        h = self._collectors.get(name)
+        if h is None:
+            h = Histogram(name, help, buckets)
+            self._collectors[name] = h
+        return h  # type: ignore[return-value]
+
+    def get(self, name: str):
+        return self._collectors.get(name)
+
+    def expose(self) -> str:
+        static = _labels_key({k: str(v) for k, v in
+                              self.static_labels.items() if v is not None})
+        return "\n".join(c.expose(static)
+                         for c in self._collectors.values()) + "\n"
+
+
+class MetricsServer:
+    """Threaded HTTP scrape server on service-port+1000
+    (triton-metrics analog)."""
+
+    def __init__(self, collector: MetricsCollector, address: str = "0.0.0.0",
+                 port: int = 0) -> None:
+        self.collector = collector
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = outer.collector.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((address, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

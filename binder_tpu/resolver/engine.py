@@ -1,0 +1,315 @@
+"""Query-resolution engine — the business logic of the DNS service.
+
+Port of the reference's ``lib/server.js`` ``resolve()`` (:136-429) and
+``resolvePtr()`` (:67-134), preserving its deliberate, failover-oriented
+rcode policy exactly (SURVEY §7.3 calls these "behaviorally load-bearing"):
+
+- Names outside the DNS domain, invalid names, SRV-shaped names that don't
+  parse, and cache misses (without recursion) are **REFUSED**, not
+  NXDOMAIN/NODATA, so downstream resolvers fail over to their next
+  nameserver instead of erroring out (comment at ``lib/server.js:227-241``).
+- The store being unavailable is **SERVFAIL** (``lib/server.js:186-192``).
+- An SRV query for a name we own that isn't a service gets NOERROR +
+  SOA authority (NODATA with negative-caching TTL, ``lib/server.js:276-292``).
+- An SRV query whose service/proto labels don't match the registered ones
+  is **NXDOMAIN** (``lib/server.js:334-345``).
+- TTL precedence is three-level, deepest-object-wins: default 30s ←
+  record.ttl ← record[type].ttl, plus the nested ``service.service`` case
+  (``lib/server.js:262-274,326-332``) and min(service-ttl, member-ttl) for
+  plain-A service answers (``lib/server.js:403-414``).
+
+Known deviation: the reference's "doubled-up dns domain suffix" REFUSED
+check (``lib/server.js:167-175``) is dead code — its ``stripSuffix`` helper
+appends ``'...'`` to the stripped name, so the subsequent ``isSuffix`` never
+matches.  We implement the evident intent (refuse ``x.foo.com.foo.com`` and
+``x.foo.com.<dc>.foo.com``); the externally visible rcode is REFUSED either
+way (the reference would miss the cache and refuse too), but we skip the
+pointless recursion attempt the reference would make.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import re
+from typing import Optional
+from urllib.parse import urlparse
+
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.dns.wire import (
+    ARecord,
+    PTRRecord,
+    Rcode,
+    SOARecord,
+    SRVRecord,
+    Type,
+)
+from binder_tpu.store.cache import MirrorCache
+
+SRV_RE = re.compile(r"^(_[^_.]*)\.(_[^_.]*)\.(.*)$")
+NAME_RE = re.compile(r"[^a-z0-9_.-]")
+
+# Child record types eligible to back a service answer
+# (lib/server.js:352-360 — note: plain 'host' and 'db_host' are excluded).
+SERVICE_CHILD_TYPES = frozenset({
+    "load_balancer", "moray_host", "ops_host", "rr_host", "redis_host",
+})
+
+DEFAULT_TTL = 30  # reference lib/server.js:270 (the ZK session timeout)
+
+
+def _is_suffix(suffix: str, s: str) -> bool:
+    return s.endswith(suffix)
+
+
+def _record_ttl(record: dict, sub: dict, default: int = DEFAULT_TTL) -> int:
+    """Deepest-object-wins TTL precedence (lib/server.js:262-274)."""
+    ttl = default
+    if isinstance(record, dict) and record.get("ttl") is not None:
+        ttl = record["ttl"]
+    if isinstance(sub, dict) and sub.get("ttl") is not None:
+        ttl = sub["ttl"]
+    return ttl
+
+
+def _valid_record(record) -> bool:
+    """Record must be a dict with a string type and an object sub-record
+    (lib/server.js:251-259)."""
+    return (isinstance(record, dict)
+            and isinstance(record.get("type"), str)
+            and isinstance(record.get(record["type"]), dict))
+
+
+class Resolver:
+    """Stateless resolution engine over a mirror cache (+ optional
+    recursion)."""
+
+    def __init__(self, zk_cache: MirrorCache, dns_domain: str,
+                 datacenter_name: str = "",
+                 recursion=None,
+                 log: Optional[logging.Logger] = None,
+                 rng: Optional[random.Random] = None) -> None:
+        self.cache = zk_cache
+        self.dns_domain = dns_domain.lower() if dns_domain else ""
+        self.datacenter_name = datacenter_name
+        self.recursion = recursion
+        self.log = log or logging.getLogger("binder.resolver")
+        self.rng = rng or random.Random()
+
+    # -- entry point used by the server engine (lib/server.js:491-506) --
+    #
+    # Synchronous: cache-served queries complete inline (the hot path);
+    # only the recursion handoff returns an awaitable for the caller to
+    # drive (cross-DC network I/O).
+
+    def handle(self, query: QueryCtx):
+        qt = query.qtype()
+        if qt in (Type.A, Type.SRV):
+            return self.resolve(query)
+        if qt == Type.PTR:
+            return self.resolve_ptr(query)
+        # anything unsupported we tell the client the truth
+        query.set_error(Rcode.NOTIMP)
+        query.respond()
+        return None
+
+    # -- forward resolution (lib/server.js:136-429) --
+
+    def resolve(self, query: QueryCtx):
+        domain = query.name()
+
+        service = protocol = None
+        m = SRV_RE.match(domain)
+        if query.qtype() == Type.SRV:
+            if not m or len(m.group(3)) < 1:
+                query.log_ctx["reason"] = "not a valid SRV lookup domain"
+                query.set_error(Rcode.REFUSED)
+                query.respond()
+                return
+            service, protocol, domain = m.group(1), m.group(2), m.group(3)
+
+        if self.dns_domain:
+            if _is_suffix("." + self.dns_domain, domain):
+                stripped = domain[:-(len(self.dns_domain) + 1)]
+            else:
+                query.log_ctx["reason"] = "not within dns domain suffix"
+                query.set_error(Rcode.REFUSED)
+                query.respond()
+                return
+            dcsuff = self.dns_domain + "." + self.datacenter_name
+            if (stripped == self.dns_domain
+                    or _is_suffix("." + self.dns_domain, stripped)
+                    or stripped == dcsuff
+                    or _is_suffix("." + dcsuff, stripped)):
+                query.log_ctx["reason"] = "doubled-up dns domain suffix"
+                query.set_error(Rcode.REFUSED)
+                query.respond()
+                return
+
+        query.log_ctx["query"] = {
+            "srv": f"{service}.{protocol}" if service else None,
+            "name": domain,
+            "type": query.qtype_name(),
+        }
+
+        if not self.cache.is_ready():
+            self.log.error("no coordination-store session")
+            query.set_error(Rcode.SERVFAIL)
+            query.respond()
+            return
+
+        if len(domain) < 1:
+            query.set_error(Rcode.REFUSED)
+            query.respond()
+            return
+
+        domain = domain.lower()
+        if NAME_RE.search(domain):
+            query.log_ctx["reason"] = "invalid name"
+            query.set_error(Rcode.REFUSED)
+            query.respond()
+            return
+
+        node = self.cache.lookup(domain)
+
+        if node is None:
+            if self.recursion is not None and query.rd():
+                return self.recursion.resolve(query)
+            # REFUSED, not NXDOMAIN: clients must fail over to their next
+            # nameserver (lib/server.js:227-241)
+            query.set_error(Rcode.REFUSED)
+            query.stamp("pre-resp")
+            query.respond()
+            return
+
+        record = node.data
+        if not _valid_record(record):
+            self.log.error("invalid store record at %s: %r", domain, record)
+            query.set_error(Rcode.SERVFAIL)
+            query.stamp("pre-resp")
+            query.respond()
+            return
+
+        sub = record[record["type"]]
+        ttl = _record_ttl(record, sub)
+
+        if service is not None and record["type"] != "service":
+            # SRV on a non-service name we own: NODATA + SOA for negative
+            # caching (lib/server.js:276-292)
+            query.set_error(Rcode.NOERROR)
+            query.add_authority(SOARecord(
+                name=domain, ttl=ttl, mname=self.dns_domain, minimum=ttl))
+            query.stamp("build_response")
+            query.respond()
+            return
+
+        rtype = record["type"]
+        if rtype == "database":
+            addr = urlparse(sub.get("primary", "")).hostname
+            query.add_answer(ARecord(name=domain, ttl=ttl, address=addr))
+        elif rtype in ("db_host", "host", "load_balancer", "moray_host",
+                       "redis_host", "ops_host", "rr_host"):
+            query.add_answer(ARecord(name=domain, ttl=ttl,
+                                     address=sub.get("address")))
+        elif rtype == "service":
+            self._resolve_service(query, node, record, domain,
+                                  service, protocol, ttl)
+        else:
+            self.log.error("record type %r in store is unknown", rtype)
+
+        query.stamp("pre-resp")
+        query.respond()
+
+    def _resolve_service(self, query: QueryCtx, node, record: dict,
+                         domain: str, service: Optional[str],
+                         protocol: Optional[str], ttl: int) -> None:
+        s = record["service"]
+        if isinstance(s.get("service"), dict):
+            # nested historical format; TTL may live here too
+            s = s["service"]
+        if s.get("ttl") is not None:
+            ttl = s["ttl"]
+
+        if service is not None and (service != s.get("srvce")
+                                    or protocol != s.get("proto")):
+            # SRV for a service/proto that doesn't match the registered
+            # one: we own the name, so NXDOMAIN (lib/server.js:334-345)
+            query.set_error(Rcode.NXDOMAIN)
+            return
+
+        # explicit NOERROR so an empty service doesn't fall through
+        # (lib/server.js:347-351)
+        query.set_error(Rcode.NOERROR)
+
+        kids = [k for k in node.children
+                if isinstance(k.data, dict)
+                and k.data.get("type") in SERVICE_CHILD_TYPES]
+        self.rng.shuffle(kids)
+
+        for knode in kids:
+            krec = knode.data
+            if not _valid_record(krec):
+                query.set_error(Rcode.SERVFAIL)
+                self.log.error("bad store info under %s", domain)
+                break
+            ksub = krec[krec["type"]]
+            addr = ksub.get("address")
+            if addr is None:
+                continue
+            ports = ksub.get("ports")
+            if not ports:
+                ports = [s.get("port")]
+            rttl = _record_ttl(krec, ksub, ttl)
+
+            if service is not None:
+                nm = f"{knode.name}.{domain}"
+                for p in ports:
+                    query.add_answer(SRVRecord(
+                        name=query.name(), ttl=ttl, priority=0, weight=10,
+                        port=p, target=nm))
+                query.add_additional(ARecord(name=nm, ttl=rttl, address=addr))
+            else:
+                # plain A for a service: membership AND address — use the
+                # smaller of the two TTLs (lib/server.js:403-414)
+                query.add_answer(ARecord(name=domain, ttl=min(ttl, rttl),
+                                         address=addr))
+
+    # -- reverse resolution (lib/server.js:67-134) --
+
+    def resolve_ptr(self, query: QueryCtx):
+        domain = query.name()
+        parts = list(reversed(domain.split(".")))
+        if len(parts) < 2 or parts[0] != "arpa" or parts[1] != "in-addr":
+            # v6 reverse names included: the reference only serves IPv4 PTR
+            query.log_ctx["reason"] = "not an ipv4 reverse name"
+            query.set_error(Rcode.REFUSED)
+            query.respond()
+            return
+        # No octet validation: an invalid address simply misses the cache
+        # and is REFUSED, so the client tries its next NS
+        # (comment at lib/server.js:79-83)
+        ip = ".".join(parts[2:])
+
+        if not self.cache.is_ready():
+            self.log.error("no coordination-store session")
+            query.set_error(Rcode.SERVFAIL)
+            query.respond()
+            return
+
+        query.log_ctx["query"] = {"ip": ip, "type": query.qtype_name()}
+
+        node = self.cache.reverse_lookup(ip)
+        if node is None:
+            if self.recursion is not None and query.rd():
+                return self.recursion.resolve(query)
+            query.set_error(Rcode.REFUSED)
+            query.stamp("pre-resp")
+            query.respond()
+            return
+
+        record = node.data if isinstance(node.data, dict) else {}
+        rtype = record.get("type")
+        sub = record.get(rtype) if isinstance(rtype, str) else None
+        ttl = _record_ttl(record, sub if isinstance(sub, dict) else {})
+        query.add_answer(PTRRecord(name=domain, ttl=ttl, target=node.domain))
+        query.stamp("pre-resp")
+        query.respond()

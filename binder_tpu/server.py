@@ -1,0 +1,143 @@
+"""The binder server: transport engine + resolution + observability.
+
+Port of the reference's ``createServer`` wiring (``lib/server.js:435-660``):
+attaches the resolution engine to the transport engine's ``query`` hook,
+and metrics + structured query logging to the ``after`` hook.  ``start()``
+brings up UDP + TCP listeners and, when configured, the balancer UNIX
+socket (``lib/server.js:609-653``).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.dns.server import DnsServer
+from binder_tpu.dns.wire import (
+    ARecord,
+    OPTRecord,
+    Rcode,
+    SRVRecord,
+    Type,
+)
+from binder_tpu.metrics.collector import (
+    DEFAULT_SIZE_BUCKETS,
+    MetricsCollector,
+)
+from binder_tpu.resolver.engine import Resolver
+from binder_tpu.utils.jsonlog import log_event
+
+METRIC_REQUEST_COUNTER = "binder_requests_completed"
+METRIC_LATENCY_HISTOGRAM = "binder_request_latency_seconds"
+METRIC_SIZE_HISTOGRAM = "binder_response_size_bytes"
+
+SLOW_QUERY_MS = 1000.0  # log at warn above this (lib/server.js:511-514)
+
+
+def strip_suffix(suffix: str, s: str) -> str:
+    """Log redaction of the (long, constant) DNS domain
+    (lib/server.js:60-65)."""
+    if s.endswith(suffix):
+        return s[:len(s) - len(suffix)] + "..."
+    return s
+
+
+class BinderServer:
+    def __init__(self, *, zk_cache, dns_domain: str,
+                 datacenter_name: str = "",
+                 recursion=None,
+                 log: Optional[logging.Logger] = None,
+                 collector: Optional[MetricsCollector] = None,
+                 name: str = "binder",
+                 host: str = "127.0.0.1", port: int = 53,
+                 balancer_socket: Optional[str] = None) -> None:
+        self.log = log or logging.getLogger("binder.server")
+        self.host = host
+        self.port = port
+        self.dns_domain = dns_domain
+        self.balancer_socket = balancer_socket
+        self.collector = collector or MetricsCollector()
+
+        self.request_counter = self.collector.counter(
+            METRIC_REQUEST_COUNTER, "count of Binder requests completed")
+        self.latency_histogram = self.collector.histogram(
+            METRIC_LATENCY_HISTOGRAM,
+            "total time to process Binder requests")
+        self.size_histogram = self.collector.histogram(
+            METRIC_SIZE_HISTOGRAM, "size in bytes of Binder responses",
+            buckets=DEFAULT_SIZE_BUCKETS)
+
+        self.resolver = Resolver(zk_cache, dns_domain=dns_domain,
+                                 datacenter_name=datacenter_name,
+                                 recursion=recursion, log=self.log)
+        self.engine = DnsServer(log=self.log, name=name)
+        self.engine.on_query = self._on_query
+        self.engine.on_after = self._on_after
+
+        # actual bound ports (for tests / ephemeral binds)
+        self.udp_port: Optional[int] = None
+        self.tcp_port: Optional[int] = None
+
+    # -- query hook (lib/server.js:471-507); sync, may return an awaitable
+    # for the recursion path (see DnsServer._dispatch) --
+
+    def _on_query(self, query: QueryCtx):
+        query.log_ctx.update({
+            "req_id": query.request.id,
+            "client": query.src[0],
+            "port": f"{query.src[1]}/{query.protocol}",
+            "edns": query.request.edns is not None,
+        })
+        return self.resolver.handle(query)
+
+    # -- after hook: metrics + query log (lib/server.js:509-591) --
+
+    def _on_after(self, query: QueryCtx) -> None:
+        query.stamp("log-after")
+        lat_ms = query.latency_ms()
+        level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
+
+        labels = {"type": query.qtype_name()}
+        self.request_counter.increment(labels)
+        self.latency_histogram.observe(lat_ms / 1000.0, labels)
+        self.size_histogram.observe(query.bytes_sent, labels)
+
+        log_event(
+            self.log, level, "DNS query",
+            **query.log_ctx,
+            rcode=Rcode.name(query.rcode()),
+            answers=[self._summarize(r) for r in query.response.answers],
+            additional=[self._summarize(r)
+                        for r in query.response.additionals
+                        if not isinstance(r, OPTRecord)],
+            latency=lat_ms,
+            timers=query.times,
+        )
+
+    def _summarize(self, rec) -> object:
+        if isinstance(rec, SRVRecord):
+            return (f"SRV {strip_suffix('.' + self.dns_domain, rec.target)}"
+                    f":{rec.port}")
+        if isinstance(rec, ARecord):
+            return (f"{strip_suffix('.' + self.dns_domain, rec.name)} "
+                    f"A {rec.address}")
+        d = {"type": Type.name(rec.rtype), "name": rec.name, "ttl": rec.ttl}
+        if hasattr(rec, "target"):
+            d["target"] = rec.target
+        return d
+
+    # -- lifecycle (lib/server.js:609-657) --
+
+    async def start(self) -> None:
+        if self.balancer_socket:
+            await self.engine.listen_balancer(self.balancer_socket)
+        self.udp_port = await self.engine.listen_udp(self.host, self.port)
+        self.tcp_port = await self.engine.listen_tcp(
+            self.host, self.port if self.port else self.udp_port)
+
+    async def stop(self) -> None:
+        await self.engine.close()
+
+
+def create_server(**kwargs) -> BinderServer:
+    return BinderServer(**kwargs)

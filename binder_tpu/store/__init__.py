@@ -1,0 +1,9 @@
+"""Coordination-store layer: client interface, fake store, mirror cache."""
+from binder_tpu.store.cache import (  # noqa: F401
+    HOST_TYPES,
+    MirrorCache,
+    TreeNode,
+    domain_to_path,
+)
+from binder_tpu.store.fake import FakeStore  # noqa: F401
+from binder_tpu.store.interface import StoreClient, Watcher  # noqa: F401

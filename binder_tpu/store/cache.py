@@ -1,0 +1,190 @@
+"""Watch-driven in-memory mirror of the coordination-store tree.
+
+Port of the reference's ZKCache/TreeNode (``lib/zk.js:20-228``): one node
+per domain label, eagerly mirroring the whole subtree under the DNS domain
+so the query path never touches the store (SURVEY §3.5 — "what makes §3.2
+I/O-free").
+
+Key behaviors preserved:
+- ``domain_to_path``: ``a.foo.com → /com/foo/a`` (``lib/zk.js:225-228``).
+- One watcher per znode; children diffs keep existing nodes, create+bind
+  added ones, unbind removed subtrees (``lib/zk.js:120-138``).
+- Full tree rebind on every session event (``lib/zk.js:45-47,68-76``);
+  ``is_ready()`` is false only until the first session.
+- Unparseable or non-object znode JSON is ignored, keeping prior data
+  (``lib/zk.js:139-154``).
+- Host-like record types maintain the IP → node reverse map for PTR
+  (``lib/zk.js:172-193``).
+
+Deliberate deviations (stale-reverse-entry hazards the reference survey
+flags in §7.3; both strictly reduce wrong answers):
+- The reverse map only drops an IP entry if it still points at the node
+  being updated (the reference deletes unconditionally, clobbering an entry
+  another node may now own, ``lib/zk.js:184-185``).
+- ``unbind`` also removes the node's reverse entry; the reference leaks it,
+  so PTR queries could resolve to hosts that left the tree
+  (``lib/zk.js:195-208`` never touches ca_revLookup).
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional
+
+from binder_tpu.store.interface import StoreClient, Watcher
+
+# Record types that represent a single addressable host: these maintain the
+# reverse (PTR) map and are the types a service's children may carry.
+# Reference ``lib/zk.js:172-179``.
+HOST_TYPES = frozenset({
+    "db_host", "host", "load_balancer", "moray_host",
+    "redis_host", "ops_host", "rr_host",
+})
+
+
+def domain_to_path(domain: str) -> str:
+    assert domain
+    return "/" + "/".join(reversed(domain.split(".")))
+
+
+class TreeNode:
+    """One mirrored znode == one domain label (reference TreeNode)."""
+
+    __slots__ = ("name", "domain", "path", "cache", "kids", "data", "ip",
+                 "watcher", "log")
+
+    def __init__(self, cache: "MirrorCache", parent_domain: str,
+                 name: str) -> None:
+        self.name = name
+        domain = name if not parent_domain else name + "." + parent_domain
+        self.domain = domain.lower()
+        self.path = domain_to_path(self.domain)
+        self.cache = cache
+        self.kids: Dict[str, TreeNode] = {}
+        self.data = None
+        self.ip: Optional[str] = None
+        self.watcher: Optional[Watcher] = None
+        self.log = cache.log
+        cache.nodes[self.domain] = self
+
+    @property
+    def children(self) -> List["TreeNode"]:
+        return list(self.kids.values())
+
+    # -- watch event handlers --
+
+    def on_children_changed(self, kids: List[str]) -> None:
+        new_kids: Dict[str, TreeNode] = {}
+        for kid in kids:
+            existing = self.kids.pop(kid, None)
+            if existing is not None:
+                new_kids[kid] = existing
+            else:
+                node = TreeNode(self.cache, self.domain, kid)
+                new_kids[kid] = node
+                node.rebind()
+        for removed in list(self.kids.values()):
+            removed.unbind()
+        self.kids = new_kids
+
+    def on_data_changed(self, data: bytes) -> None:
+        try:
+            parsed = json.loads(data.decode("utf-8")) if data else None
+        except (ValueError, UnicodeDecodeError) as e:
+            self.log.warning("ignoring node %s: failed to parse data: %s",
+                             self.path, e)
+            return
+        # JS typeof-object check admits dicts, lists, and null
+        # (lib/zk.js:149-154); anything else is ignored, keeping old data.
+        if parsed is not None and not isinstance(parsed, (dict, list)):
+            self.log.warning("ignoring node %s: parsed JSON is not an object",
+                             self.path)
+            return
+        self.data = parsed
+
+        rtype = parsed.get("type") if isinstance(parsed, dict) else None
+        if not isinstance(rtype, str) or rtype not in HOST_TYPES:
+            # no longer (or never was) a host-like record: drop any reverse
+            # entry we own so PTR can't serve a stale mapping
+            self._drop_rev_entry()
+            return
+        record = parsed.get(rtype)
+        if not isinstance(record, dict):
+            self._drop_rev_entry()
+            return
+        addr = record.get("address")
+        self._drop_rev_entry()
+        self.ip = addr
+        if addr:
+            self.cache.rev_lookup[addr] = self
+
+    def _drop_rev_entry(self) -> None:
+        if self.ip and self.cache.rev_lookup.get(self.ip) is self:
+            del self.cache.rev_lookup[self.ip]
+        self.ip = None
+
+    # -- lifecycle --
+
+    def rebind(self) -> None:
+        """(Re-)register watchers for this subtree (lib/zk.js:209-223).
+
+        Kids that exist *before* re-registering need explicit rebinds; kids
+        created during the (possibly synchronous) initial children delivery
+        were already bound by on_children_changed and must not be rebound
+        again — with a synchronous store that would compound to 2^depth
+        redundant rebinds per session event.
+        """
+        existing = list(self.kids.values())
+        if self.watcher is not None:
+            self.watcher.clear()
+        self.watcher = self.cache.store.watcher(self.path)
+        self.watcher.on("children", self.on_children_changed)
+        self.watcher.on("data", self.on_data_changed)
+        for kid in existing:
+            if self.kids.get(kid.name) is kid:
+                kid.rebind()
+
+    def unbind(self) -> None:
+        self.log.debug("unbinding node at %s", self.path)
+        if self.watcher is not None:
+            self.watcher.clear()
+        for kid in list(self.kids.values()):
+            kid.unbind()
+        if self.cache.nodes.get(self.domain) is self:
+            del self.cache.nodes[self.domain]
+        if self.ip and self.cache.rev_lookup.get(self.ip) is self:
+            del self.cache.rev_lookup[self.ip]
+
+
+class MirrorCache:
+    """The ZKCache equivalent: domain-keyed node index + reverse-IP index."""
+
+    def __init__(self, store: StoreClient, domain: str,
+                 log: Optional[logging.Logger] = None) -> None:
+        self.store = store
+        self.domain = domain.lower()
+        self.log = log or logging.getLogger("binder.cache")
+        self.nodes: Dict[str, TreeNode] = {}
+        self.rev_lookup: Dict[str, TreeNode] = {}
+        store.on_session(self.rebuild)
+
+    def is_ready(self) -> bool:
+        return self.domain in self.nodes
+
+    def lookup(self, domain: str) -> Optional[TreeNode]:
+        return self.nodes.get(domain)
+
+    def reverse_lookup(self, ip: str) -> Optional[TreeNode]:
+        return self.rev_lookup.get(ip)
+
+    def rebuild(self) -> None:
+        """Re-mirror from scratch-or-current on (re)session
+        (lib/zk.js:68-76)."""
+        tn = self.nodes.get(self.domain)
+        if tn is None:
+            parts = self.domain.split(".")
+            tn = TreeNode(self, ".".join(parts[1:]), parts[0])
+        tn.rebind()
+
+    def stop(self) -> None:
+        self.store.close()

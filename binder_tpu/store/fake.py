@@ -1,0 +1,199 @@
+"""In-memory fake coordination store.
+
+Stands in for ZooKeeper in tests and benchmarks — the piece the reference
+lacks entirely (SURVEY §4: its tests require a live ZK at 127.0.0.1:2181).
+Implements the ``StoreClient`` interface with synchronous watch delivery:
+
+- ``mkdirp/create/set_data/delete/rmr`` mutate the znode tree and fire the
+  affected watchers exactly like a ZK server would (children event on the
+  parent, data event on the node).
+- Initial state is delivered when a listener attaches to a watcher, which
+  is when the mirror cache rebinds (matching zkstream's register-then-fetch
+  behavior the cache relies on, reference ``lib/zk.js:209-223``).
+- ``expire_session()`` simulates ZK session loss + re-establishment: the
+  ``session`` callbacks re-fire and the cache rebuilds its watch tree
+  (reference ``lib/zk.js:45-47``).
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Tuple
+
+from binder_tpu.store.interface import StoreClient, Watcher
+
+
+class _Node:
+    __slots__ = ("data", "children")
+
+    def __init__(self, data: bytes = b"") -> None:
+        self.data = data
+        self.children: Dict[str, _Node] = {}
+
+
+class FakeStore(StoreClient):
+    def __init__(self) -> None:
+        self._root = _Node()
+        self._watchers: Dict[str, Watcher] = {}
+        self._session_cbs: List[Callable[[], None]] = []
+        self._connected = False
+
+    # -- StoreClient interface --
+
+    def on_session(self, cb: Callable[[], None]) -> None:
+        self._session_cbs.append(cb)
+        if self._connected:
+            cb()
+
+    def watcher(self, path: str) -> Watcher:
+        w = self._watchers.get(path)
+        if w is None:
+            w = _FakeWatcher(self, path)
+            self._watchers[path] = w
+        return w
+
+    def is_connected(self) -> bool:
+        return self._connected
+
+    def close(self) -> None:
+        self._connected = False
+
+    # -- session simulation --
+
+    def start_session(self) -> None:
+        self._connected = True
+        for cb in list(self._session_cbs):
+            cb()
+
+    def expire_session(self) -> None:
+        """Session loss immediately followed by a new session."""
+        self._connected = False
+        self.start_session()
+
+    # -- tree access --
+
+    def _find(self, path: str) -> Optional[_Node]:
+        node = self._root
+        for part in _parts(path):
+            node = node.children.get(part)
+            if node is None:
+                return None
+        return node
+
+    def exists(self, path: str) -> bool:
+        return self._find(path) is not None
+
+    def get_data(self, path: str) -> Optional[bytes]:
+        n = self._find(path)
+        return None if n is None else n.data
+
+    def get_children(self, path: str) -> Optional[List[str]]:
+        n = self._find(path)
+        return None if n is None else sorted(n.children)
+
+    # -- mutations (the registrar-equivalent write surface) --
+
+    def mkdirp(self, path: str, data: bytes = b"") -> None:
+        """Create *path* and any missing parents (test/helper.js zkMkdirP
+        analog, reference ``test/helper.js:98-129``)."""
+        node = self._root
+        parent_path = "/"
+        prefix = ""
+        for part in _parts(path):
+            prefix += "/" + part
+            child = node.children.get(part)
+            if child is None:
+                child = _Node()
+                node.children[part] = child
+                self._fire_children(parent_path, node)
+            node = child
+            parent_path = prefix
+        if data:
+            node.data = data
+            self._fire_data(prefix, node)
+
+    def create(self, path: str, data: bytes = b"") -> None:
+        parent_path, name = _split(path)
+        parent = self._find(parent_path)
+        if parent is None:
+            raise KeyError(f"no such parent: {parent_path}")
+        if name in parent.children:
+            raise KeyError(f"node exists: {path}")
+        parent.children[name] = _Node(data)
+        self._fire_children(parent_path, parent)
+        if data:
+            self._fire_data(path, parent.children[name])
+
+    def set_data(self, path: str, data: bytes) -> None:
+        node = self._find(path)
+        if node is None:
+            raise KeyError(f"no such node: {path}")
+        node.data = data
+        self._fire_data(path, node)
+
+    def delete(self, path: str) -> None:
+        parent_path, name = _split(path)
+        parent = self._find(parent_path)
+        if parent is None or name not in parent.children:
+            raise KeyError(f"no such node: {path}")
+        if parent.children[name].children:
+            raise KeyError(f"node has children: {path}")
+        del parent.children[name]
+        self._fire_children(parent_path, parent)
+
+    def rmr(self, path: str) -> None:
+        """Recursive delete (test/helper.js zkRmr analog)."""
+        node = self._find(path)
+        if node is None:
+            return
+        for kid in list(node.children):
+            self.rmr(path.rstrip("/") + "/" + kid)
+        self.delete(path)
+
+    # convenience for fixtures
+    def put_json(self, path: str, obj) -> None:
+        data = json.dumps(obj).encode("utf-8")
+        if self.exists(path):
+            self.set_data(path, data)
+        else:
+            self.mkdirp(path, data)
+
+    # -- watch plumbing --
+
+    def _fire_children(self, path: str, node: _Node) -> None:
+        w = self._watchers.get(path)
+        if w is not None and self._connected:
+            w.emit("children", sorted(node.children))
+
+    def _fire_data(self, path: str, node: _Node) -> None:
+        w = self._watchers.get(path)
+        if w is not None and self._connected:
+            w.emit("data", node.data)
+
+
+class _FakeWatcher(Watcher):
+    """Watcher that delivers current state as soon as a listener attaches."""
+
+    def __init__(self, store: FakeStore, path: str) -> None:
+        super().__init__(path)
+        self._store = store
+
+    def on(self, event: str, cb: Callable) -> None:
+        super().on(event, cb)
+        node = self._store._find(self.path)
+        if node is None or not self._store._connected:
+            return
+        if event == "children":
+            cb(sorted(node.children))
+        elif event == "data":
+            cb(node.data)
+
+
+def _parts(path: str) -> List[str]:
+    return [p for p in path.split("/") if p]
+
+
+def _split(path: str) -> Tuple[str, str]:
+    parts = _parts(path)
+    if not parts:
+        raise KeyError("cannot operate on root")
+    return "/" + "/".join(parts[:-1]), parts[-1]

@@ -1,0 +1,80 @@
+"""Coordination-store client interface.
+
+The reference binds its cache directly to zkstream (reference
+``lib/zk.js:33-39``) — its biggest testability gap (SURVEY §4: every test
+needs a live ZooKeeper).  The rebuild defines this narrow interface instead,
+with two implementations:
+
+- ``binder_tpu.store.fake.FakeStore`` — in-memory, synchronous; used by
+  tests and ``bench.py``.
+- ``binder_tpu.store.zk_client.ZKClient`` — real ZooKeeper wire protocol
+  (jute) over asyncio.
+
+Semantics modeled on zkstream's surface as consumed by the cache:
+
+- The client emits a ``session`` event whenever a (new) session is
+  established; the cache responds by re-binding its whole watch tree
+  (reference ``lib/zk.js:45-47``).
+- ``watcher(path)`` returns a ``Watcher`` handle.  Registering listeners is
+  idempotent w.r.t. rebinds: the cache clears listeners and re-adds them on
+  every rebind.  After (re)registration the store fires the current state —
+  a ``children`` event with the current child names and a ``data`` event
+  with the current node bytes — and again on every subsequent change.
+- Watch events carry state, not deltas: ``children`` always delivers the
+  full current child list.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+
+class Watcher:
+    """Per-path watch handle: holds ``children`` and ``data`` listeners.
+
+    Mirrors zkstream's watcher EventEmitter surface (``childrenChanged`` /
+    ``dataChanged``) as used at reference ``lib/zk.js:215-219``.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._listeners: Dict[str, List[Callable]] = {"children": [], "data": []}
+
+    def on(self, event: str, cb: Callable) -> None:
+        self._listeners[event].append(cb)
+
+    def clear(self) -> None:
+        """Remove all listeners (reference removeAllListeners,
+        ``lib/zk.js:211-214``)."""
+        for lst in self._listeners.values():
+            lst.clear()
+
+    def emit(self, event: str, *args) -> None:
+        for cb in list(self._listeners[event]):
+            cb(*args)
+
+    @property
+    def has_listeners(self) -> bool:
+        return any(self._listeners.values())
+
+
+class StoreClient:
+    """Abstract coordination-store client (zkstream-equivalent surface)."""
+
+    def on_session(self, cb: Callable[[], None]) -> None:
+        """Register a callback fired on every session (re-)establishment."""
+        raise NotImplementedError
+
+    def watcher(self, path: str) -> Watcher:
+        """Return the watch handle for *path* (created on first use).
+
+        After the caller attaches listeners, the store must deliver the
+        current state of the node (children + data) and keep delivering on
+        changes, for as long as the session lasts.
+        """
+        raise NotImplementedError
+
+    def is_connected(self) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError

@@ -1,0 +1,236 @@
+"""Tests for the coordination-store mirror cache (binder_tpu/store).
+
+Covers the reference's watch-tree semantics (lib/zk.js) plus the churn /
+session-reset hazards SURVEY §7.3 calls out — none of which the reference
+itself tests (it has no fake store, SURVEY §4).
+"""
+import json
+
+import pytest
+
+from binder_tpu.store import FakeStore, MirrorCache, domain_to_path
+
+
+DOMAIN = "foo.com"
+
+
+def make_cache():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    return store, cache
+
+
+def host(addr, **extra):
+    rec = {"type": "host", "host": {"address": addr}}
+    rec.update(extra)
+    return rec
+
+
+class TestDomainPath:
+    def test_mapping(self):
+        assert domain_to_path("a.foo.com") == "/com/foo/a"
+        assert domain_to_path("foo.com") == "/com/foo"
+
+
+class TestReadiness:
+    def test_not_ready_before_session(self):
+        store, cache = make_cache()
+        assert not cache.is_ready()
+
+    def test_ready_after_session(self):
+        store, cache = make_cache()
+        store.start_session()
+        assert cache.is_ready()
+
+    def test_ready_survives_session_loss(self):
+        # reference keeps serving from the stale mirror during reconnects
+        store, cache = make_cache()
+        store.start_session()
+        store.expire_session()
+        assert cache.is_ready()
+
+
+class TestMirror:
+    def test_host_lookup(self):
+        store, cache = make_cache()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.start_session()
+        node = cache.lookup("web.foo.com")
+        assert node is not None
+        assert node.data["host"]["address"] == "10.0.0.5"
+
+    def test_fixture_added_after_session(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        assert cache.lookup("web.foo.com").data["type"] == "host"
+
+    def test_reverse_lookup(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        assert cache.reverse_lookup("10.0.0.5").domain == "web.foo.com"
+
+    def test_deep_tree_children(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/svc", {
+            "type": "service",
+            "service": {"srvce": "_http", "proto": "_tcp", "port": 80},
+        })
+        for i in range(3):
+            store.put_json(f"/com/foo/svc/h{i}",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": f"10.0.1.{i}"}})
+        node = cache.lookup("svc.foo.com")
+        assert len(node.children) == 3
+        assert cache.lookup("h1.svc.foo.com") is not None
+
+    def test_data_update_moves_reverse_entry(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.put_json("/com/foo/web", host("10.0.0.9"))
+        assert cache.reverse_lookup("10.0.0.5") is None
+        assert cache.reverse_lookup("10.0.0.9").domain == "web.foo.com"
+
+    def test_node_removal_unbinds_subtree(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/svc", {"type": "service",
+                                        "service": {"port": 80}})
+        store.put_json("/com/foo/svc/h0",
+                       {"type": "host", "host": {"address": "10.0.2.1"}})
+        assert cache.lookup("h0.svc.foo.com") is not None
+        store.rmr("/com/foo/svc")
+        assert cache.lookup("svc.foo.com") is None
+        assert cache.lookup("h0.svc.foo.com") is None
+
+    def test_node_removal_drops_reverse_entry(self):
+        # deliberate fix over the reference, which leaks ca_revLookup
+        # entries on unbind (lib/zk.js:195-208)
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.rmr("/com/foo/web")
+        assert cache.reverse_lookup("10.0.0.5") is None
+
+    def test_reverse_entry_collision_guarded(self):
+        # two nodes claim the same IP; the loser updating away must not
+        # clobber the winner's entry (reference deletes unconditionally)
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/a", host("10.0.0.5"))
+        store.put_json("/com/foo/b", host("10.0.0.5"))  # b now owns rev
+        store.put_json("/com/foo/a", host("10.0.0.6"))
+        assert cache.reverse_lookup("10.0.0.5").domain == "b.foo.com"
+        assert cache.reverse_lookup("10.0.0.6").domain == "a.foo.com"
+
+
+class TestBadData:
+    def test_unparseable_json_keeps_old_data(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.set_data("/com/foo/web", b"{not json")
+        node = cache.lookup("web.foo.com")
+        assert node.data["host"]["address"] == "10.0.0.5"
+
+    def test_scalar_json_ignored(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.set_data("/com/foo/web", b"42")
+        assert cache.lookup("web.foo.com").data["type"] == "host"
+
+    def test_null_json_accepted_as_empty(self):
+        # JS typeof null === 'object': null replaces data (lib/zk.js:149-155)
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.set_data("/com/foo/web", b"null")
+        assert cache.lookup("web.foo.com").data is None
+
+    def test_no_data_node(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.mkdirp("/com/foo/empty")
+        node = cache.lookup("empty.foo.com")
+        assert node is not None and node.data is None
+
+
+class TestSessionChurn:
+    def test_rebuild_after_expiry_reflects_changes(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        store.expire_session()
+        assert cache.lookup("web.foo.com").data["host"]["address"] == "10.0.0.5"
+        store.put_json("/com/foo/web2", host("10.0.0.7"))
+        assert cache.lookup("web2.foo.com") is not None
+
+    def test_no_duplicate_event_delivery_after_rebinds(self):
+        """Rebinding N times must not register N listeners (lib/zk.js
+        clears listeners before re-adding; leak hazard in SURVEY §7.3)."""
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        for _ in range(5):
+            store.expire_session()
+        w = store.watcher(domain_to_path("web.foo.com"))
+        assert len(w._listeners["children"]) == 1
+        assert len(w._listeners["data"]) == 1
+
+    def test_removed_subtree_watchers_are_silent(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/svc", {"type": "service",
+                                        "service": {"port": 80}})
+        store.put_json("/com/foo/svc/h0", host("10.0.2.1"))
+        store.rmr("/com/foo/svc")
+        w = store.watcher(domain_to_path("h0.svc.foo.com"))
+        assert not w.has_listeners
+        # re-creating the path must resurrect cleanly via the parent diff
+        store.put_json("/com/foo/svc", {"type": "service",
+                                        "service": {"port": 80}})
+        store.put_json("/com/foo/svc/h0", host("10.0.2.9"))
+        assert cache.lookup("h0.svc.foo.com").data["host"]["address"] == \
+            "10.0.2.9"
+        assert cache.reverse_lookup("10.0.2.9") is not None
+
+
+class TestReviewRegressions:
+    """Regressions from the second code-review pass."""
+
+    def test_type_change_drops_reverse_entry(self):
+        store, cache = make_cache()
+        store.start_session()
+        store.put_json("/com/foo/web", host("10.0.0.5"))
+        assert cache.reverse_lookup("10.0.0.5") is not None
+        store.put_json("/com/foo/web",
+                       {"type": "service", "service": {"port": 80}})
+        assert cache.reverse_lookup("10.0.0.5") is None
+
+    def test_rebind_not_exponential(self):
+        """Session rebinds must touch each node O(1) times, not 2^depth."""
+        store, cache = make_cache()
+        store.start_session()
+        # 6-deep chain under foo.com
+        path = "/com/foo"
+        for label in ["a", "b", "c", "d", "e", "f"]:
+            path += f"/{label}"
+            store.put_json(path, host("10.9.9.9") if label == "f" else
+                           {"type": "service", "service": {"port": 1}})
+        deep = store.watcher("/com/foo/a/b/c/d/e/f")
+        calls = {"n": 0}
+        orig_emit = deep.emit
+
+        def counting_emit(event, *args):
+            calls["n"] += 1
+            orig_emit(event, *args)
+
+        deep.emit = counting_emit
+        baseline = calls["n"]
+        store.expire_session()
+        # one rebind -> at most a couple of initial-state deliveries
+        assert calls["n"] - baseline <= 4, calls["n"] - baseline

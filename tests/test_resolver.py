@@ -1,0 +1,392 @@
+"""Resolution-engine tests.
+
+Mirrors the reference's integration suites case-for-case (SURVEY §4):
+test/host.test.js, test/service.test.js, test/database.test.js — plus the
+rcode-policy and TTL-precedence cases the reference never unit-tests.
+Responses are asserted on decoded wire bytes, not internal objects.
+"""
+import asyncio
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.resolver import Resolver
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+DC = "coal"
+
+
+@pytest.fixture()
+def stack():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    resolver = Resolver(cache, dns_domain=DOMAIN, datacenter_name=DC)
+    store.start_session()
+    return store, cache, resolver
+
+
+def ask(resolver, name, qtype, rd=False):
+    """Run one query through the engine; return the decoded wire response."""
+    sent = []
+    q = QueryCtx(make_query(name, qtype, qid=99, rd=rd), ("127.0.0.1", 5353),
+                 "udp", sent.append)
+    pending = resolver.handle(q)
+    if pending is not None:  # recursion path returns an awaitable
+        asyncio.run(pending)
+    assert len(sent) == 1, "engine must respond exactly once"
+    return Message.decode(sent[0])
+
+
+def put_host(store, path, addr, **extra):
+    rec = {"type": "host", "host": {"address": addr}}
+    rec.update(extra)
+    store.put_json(path, rec)
+
+
+class TestHost:
+    """Reference test/host.test.js."""
+
+    def test_a_lookup(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        r = ask(resolver, "web.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR and r.aa
+        assert [a.address for a in r.answers] == ["192.168.0.1"]
+        assert r.answers[0].ttl == 30  # default
+
+    def test_ptr_lookup(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        r = ask(resolver, "1.0.168.192.in-addr.arpa", Type.PTR)
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].target == "web.foo.com"
+
+    def test_unknown_name_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "nope.foo.com", Type.A)
+        assert r.rcode == Rcode.REFUSED and not r.answers
+
+    def test_unknown_reverse_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "9.9.9.9.in-addr.arpa", Type.PTR)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_partial_reverse_refused(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        r = ask(resolver, "0.168.192.in-addr.arpa", Type.PTR)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_non_reverse_ptr_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "web.foo.com", Type.PTR)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_ipv6_reverse_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "1.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0.0."
+                          "0.0.0.0.0.0.d.f.ip6.arpa", Type.PTR)
+        assert r.rcode == Rcode.REFUSED
+
+
+SVC = "/com/foo/svc"
+
+
+def put_service(store, port=5432, srvce="_pg", proto="_tcp", **svc_extra):
+    svc = {"srvce": srvce, "proto": proto, "port": port}
+    svc.update(svc_extra)
+    store.put_json(SVC, {"type": "service", "service": svc})
+
+
+def put_members(store):
+    """3 hosts + 2 load_balancers, as in test/service.test.js."""
+    for i in range(3):
+        store.put_json(f"{SVC}/host{i}",
+                       {"type": "host",
+                        "host": {"address": f"10.0.0.{i + 1}"}})
+    for i in range(2):
+        store.put_json(f"{SVC}/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+
+
+class TestService:
+    """Reference test/service.test.js."""
+
+    def test_round_robin_a_only_lb_children(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "svc.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR
+        # only load_balancer-type children are served (lib/server.js:352-360)
+        assert sorted(a.address for a in r.answers) == ["10.0.1.1", "10.0.1.2"]
+
+    def test_a_answers_shuffled(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        for i in range(8):
+            store.put_json(f"{SVC}/lb{i}",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+        orders = {tuple(a.address for a in
+                        ask(resolver, "svc.foo.com", Type.A).answers)
+                  for _ in range(20)}
+        assert len(orders) > 1, "answers must be shuffled for round-robin"
+
+    def test_srv_answers(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "_pg._tcp.svc.foo.com", Type.SRV)
+        assert r.rcode == Rcode.NOERROR
+        assert len(r.answers) == 2
+        assert all(a.port == 5432 for a in r.answers)
+        assert sorted(a.target for a in r.answers) == \
+            ["lb0.svc.foo.com", "lb1.svc.foo.com"]
+        # additionals carry the A records for the SRV targets
+        addl = {a.name: a.address for a in r.additionals
+                if hasattr(a, "address")}
+        assert addl == {"lb0.svc.foo.com": "10.0.1.1",
+                        "lb1.svc.foo.com": "10.0.1.2"}
+
+    def test_srv_wrong_service_nxdomain(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "_http._tcp.svc.foo.com", Type.SRV)
+        assert r.rcode == Rcode.NXDOMAIN
+
+    def test_srv_wrong_proto_nxdomain(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "_pg._udp.svc.foo.com", Type.SRV)
+        assert r.rcode == Rcode.NXDOMAIN
+
+    def test_srv_unknown_name_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "_pg._tcp.other.foo.com", Type.SRV)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_srv_invalid_shape_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "svc.foo.com", Type.SRV)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_member_a_record(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "host1.svc.foo.com", Type.A)
+        assert [a.address for a in r.answers] == ["10.0.0.2"]
+
+    def test_member_ptr(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        put_members(store)
+        r = ask(resolver, "2.1.0.10.in-addr.arpa", Type.PTR)
+        assert r.answers[0].target == "lb1.svc.foo.com"
+
+    def test_empty_service_noerror(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        r = ask(resolver, "svc.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR and not r.answers
+
+    def test_srv_on_host_nodata_with_soa(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1", ttl=77)
+        r = ask(resolver, "_pg._tcp.web.foo.com", Type.SRV)
+        assert r.rcode == Rcode.NOERROR and not r.answers
+        soa = r.authorities[0]
+        assert soa.mname == DOMAIN and soa.minimum == 77 and soa.ttl == 77
+
+    def test_member_with_null_address_skipped(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": None}})
+        store.put_json(f"{SVC}/lb1",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": "10.0.1.2"}})
+        r = ask(resolver, "svc.foo.com", Type.A)
+        assert [a.address for a in r.answers] == ["10.0.1.2"]
+
+    def test_member_ports_list_multiple_srv(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": "10.0.1.1",
+                                          "ports": [80, 443]}})
+        r = ask(resolver, "_pg._tcp.svc.foo.com", Type.SRV)
+        assert sorted(a.port for a in r.answers) == [80, 443]
+
+    def test_bad_member_record_servfail(self, stack):
+        store, cache, resolver = stack
+        put_service(store)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer", "load_balancer": None})
+        r = ask(resolver, "svc.foo.com", Type.A)
+        assert r.rcode == Rcode.SERVFAIL
+
+
+class TestDatabase:
+    """Reference test/database.test.js."""
+
+    def test_a_from_primary_url(self, stack):
+        store, cache, resolver = stack
+        store.put_json("/com/foo/pg", {
+            "type": "database",
+            "database": {"primary": "tcp://10.99.99.14:5432/postgres"},
+        })
+        r = ask(resolver, "pg.foo.com", Type.A)
+        assert [a.address for a in r.answers] == ["10.99.99.14"]
+
+
+class TestTTLPrecedence:
+    """The three-level TTL mess (SURVEY §7.3, lib/server.js:262-274)."""
+
+    def test_default_30(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/w", "10.1.1.1")
+        assert ask(resolver, "w.foo.com", Type.A).answers[0].ttl == 30
+
+    def test_root_ttl(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/w", "10.1.1.1", ttl=120)
+        assert ask(resolver, "w.foo.com", Type.A).answers[0].ttl == 120
+
+    def test_sub_ttl_wins(self, stack):
+        store, cache, resolver = stack
+        store.put_json("/com/foo/w", {
+            "type": "host", "ttl": 120,
+            "host": {"address": "10.1.1.1", "ttl": 5}})
+        assert ask(resolver, "w.foo.com", Type.A).answers[0].ttl == 5
+
+    def test_nested_service_service_ttl(self, stack):
+        store, cache, resolver = stack
+        store.put_json(SVC, {
+            "type": "service",
+            "service": {"service": {"srvce": "_pg", "proto": "_tcp",
+                                    "port": 5432, "ttl": 11}}})
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": "10.0.1.1"}})
+        r = ask(resolver, "_pg._tcp.svc.foo.com", Type.SRV)
+        assert r.answers[0].ttl == 11
+
+    def test_service_a_uses_min_ttl(self, stack):
+        store, cache, resolver = stack
+        put_service(store, ttl=100)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer", "ttl": 7,
+                        "load_balancer": {"address": "10.0.1.1"}})
+        # membership TTL (100) vs member TTL (7): serve the smaller
+        assert ask(resolver, "svc.foo.com", Type.A).answers[0].ttl == 7
+
+    def test_srv_additional_uses_member_ttl(self, stack):
+        store, cache, resolver = stack
+        put_service(store, ttl=100)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer", "ttl": 7,
+                        "load_balancer": {"address": "10.0.1.1"}})
+        r = ask(resolver, "_pg._tcp.svc.foo.com", Type.SRV)
+        assert r.answers[0].ttl == 100       # SRV carries service ttl
+        assert r.additionals[-1].ttl == 7    # A additional carries member ttl
+
+
+class TestPolicy:
+    """Failover-oriented rcode policy (lib/server.js:156-246)."""
+
+    def test_outside_domain_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "example.com", Type.A)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_doubled_suffix_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "web.foo.com.foo.com", Type.A)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_dc_doubled_suffix_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, f"web.foo.com.{DC}.foo.com", Type.A)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_store_down_servfail(self):
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        resolver = Resolver(cache, dns_domain=DOMAIN, datacenter_name=DC)
+        # no session started
+        r = ask(resolver, "web.foo.com", Type.A)
+        assert r.rcode == Rcode.SERVFAIL
+
+    def test_store_down_ptr_servfail(self):
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        resolver = Resolver(cache, dns_domain=DOMAIN, datacenter_name=DC)
+        r = ask(resolver, "1.0.168.192.in-addr.arpa", Type.PTR)
+        assert r.rcode == Rcode.SERVFAIL
+
+    def test_invalid_chars_refused(self, stack):
+        store, cache, resolver = stack
+        r = ask(resolver, "bad!name.foo.com", Type.A)
+        assert r.rcode == Rcode.REFUSED
+
+    def test_unsupported_qtype_notimp(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        r = ask(resolver, "web.foo.com", Type.AAAA)
+        assert r.rcode == Rcode.NOTIMP
+
+    def test_invalid_record_servfail(self, stack):
+        store, cache, resolver = stack
+        store.put_json("/com/foo/junk", {"type": "host"})  # no sub-object
+        r = ask(resolver, "junk.foo.com", Type.A)
+        assert r.rcode == Rcode.SERVFAIL
+
+    def test_node_without_data_servfail(self, stack):
+        store, cache, resolver = stack
+        store.mkdirp("/com/foo/empty")
+        r = ask(resolver, "empty.foo.com", Type.A)
+        assert r.rcode == Rcode.SERVFAIL
+
+    def test_unknown_record_type_empty_noerror(self, stack):
+        store, cache, resolver = stack
+        store.put_json("/com/foo/odd", {"type": "widget", "widget": {}})
+        r = ask(resolver, "odd.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR and not r.answers
+
+    def test_case_insensitive_lookup(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        r = ask(resolver, "WEB.Foo.COM", Type.A)
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "192.168.0.1"
+
+
+class TestReviewRegressions:
+    """Regressions from the second code-review pass."""
+
+    def test_suffix_check_respects_label_boundary(self, stack):
+        """'xfoo.com' merely string-ending with 'foo.com' must not trip
+        the doubled-suffix REFUSED."""
+        store, cache, resolver = stack
+        store.put_json("/com/foo/com/xfoo",
+                       {"type": "host", "host": {"address": "10.5.5.5"}})
+        r = ask(resolver, "xfoo.com.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "10.5.5.5"
+
+    def test_ptr_survives_typeless_record(self, stack):
+        store, cache, resolver = stack
+        put_host(store, "/com/foo/web", "192.168.0.1")
+        # rewrite with no 'type': reverse entry must drop, PTR -> REFUSED
+        store.put_json("/com/foo/web", {"mystery": True})
+        r = ask(resolver, "1.0.168.192.in-addr.arpa", Type.PTR)
+        assert r.rcode == Rcode.REFUSED

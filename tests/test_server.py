@@ -1,0 +1,332 @@
+"""Integration tests: full server over real UDP/TCP/balancer-socket
+transports.
+
+The protocol-level replacement for the reference's dig(1)-scraping
+integration suite (SURVEY §4) — same scenarios, but asserting on decoded
+wire responses, and runnable without a live ZooKeeper thanks to the fake
+store.
+"""
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.dns.server import pack_balancer_frame, unpack_balancer_frame
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import (
+    METRIC_LATENCY_HISTOGRAM,
+    METRIC_REQUEST_COUNTER,
+    BinderServer,
+)
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+
+
+def fixture_store():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "192.168.0.1"}})
+    store.put_json("/com/foo/svc", {
+        "type": "service",
+        "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432},
+    })
+    for i in range(40):
+        store.put_json(f"/com/foo/svc/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+    store.start_session()
+    return store, cache
+
+
+async def start_server(cache, **kw):
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="coal", host="127.0.0.1", port=0,
+                          collector=MetricsCollector(), **kw)
+    await server.start()
+    return server
+
+
+async def udp_ask(port, name, qtype, payload=1232, timeout=2.0):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+            q = make_query(name, qtype, qid=4242, edns_payload=payload)
+            transport.sendto(q.encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        data = await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+    return Message.decode(data)
+
+
+async def tcp_ask(port, name, qtype):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    wire = make_query(name, qtype, qid=7).encode()
+    writer.write(struct.pack(">H", len(wire)) + wire)
+    await writer.drain()
+    (length,) = struct.unpack(">H", await reader.readexactly(2))
+    data = await reader.readexactly(length)
+    writer.close()
+    await writer.wait_closed()
+    return Message.decode(data)
+
+
+class TestUdp:
+    def test_a_query(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            r = await udp_ask(server.udp_port, "web.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR and r.aa
+        assert r.answers[0].address == "192.168.0.1"
+
+    def test_refused_unknown(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            r = await udp_ask(server.udp_port, "nope.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+    def test_truncation_under_small_payload(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            r = await udp_ask(server.udp_port, "svc.foo.com", Type.A,
+                              payload=None)  # classic 512-byte limit
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        # 30 answers don't fit in 512b: TC set, client should retry TCP
+        assert r.tc and len(r.answers) == 0
+
+    def test_formerr_on_garbage(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+
+            class Proto(asyncio.DatagramProtocol):
+                def connection_made(self, transport):
+                    transport.sendto(b"\xde\xad\xff\xff\xff\xff")
+
+                def datagram_received(self, data, addr):
+                    if not fut.done():
+                        fut.set_result(data)
+
+            transport, _ = await loop.create_datagram_endpoint(
+                Proto, remote_addr=("127.0.0.1", server.udp_port))
+            data = await asyncio.wait_for(fut, 2)
+            transport.close()
+            await server.stop()
+            return Message.decode(data)
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.FORMERR and r.id == 0xDEAD
+
+
+class TestTcp:
+    def test_tcp_full_answer_set(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            r = await tcp_ask(server.tcp_port, "svc.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert not r.tc and len(r.answers) == 40
+
+    def test_tcp_multiple_queries_one_connection(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.tcp_port)
+            out = []
+            for i, (name, qtype) in enumerate(
+                    [("web.foo.com", Type.A),
+                     ("_pg._tcp.svc.foo.com", Type.SRV)]):
+                wire = make_query(name, qtype, qid=i + 1).encode()
+                writer.write(struct.pack(">H", len(wire)) + wire)
+                await writer.drain()
+                (ln,) = struct.unpack(">H", await reader.readexactly(2))
+                out.append(Message.decode(await reader.readexactly(ln)))
+            writer.close()
+            await writer.wait_closed()
+            await server.stop()
+            return out
+
+        r1, r2 = asyncio.run(run())
+        assert r1.id == 1 and r1.answers[0].address == "192.168.0.1"
+        assert r2.id == 2 and len(r2.answers) == 40
+
+
+class TestBalancerSocket:
+    def test_query_via_balancer_frame(self, tmp_path):
+        sock_path = str(tmp_path / "b.sock")
+
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, balancer_socket=sock_path)
+            reader, writer = await asyncio.open_unix_connection(sock_path)
+            # pretend to be the balancer forwarding a client query
+            q = make_query("web.foo.com", Type.A, qid=55).encode()
+            writer.write(pack_balancer_frame(4, "203.0.113.9", 5353, q))
+            await writer.drain()
+            (ln,) = struct.unpack(">I", await reader.readexactly(4))
+            family, addr, port, transport, payload = unpack_balancer_frame(
+                await reader.readexactly(ln))
+            writer.close()
+            await writer.wait_closed()
+            await server.stop()
+            return family, addr, port, Message.decode(payload)
+
+        family, addr, port, r = asyncio.run(run())
+        # response frame echoes the original client address for routing
+        assert (family, addr, port) == (4, "203.0.113.9", 5353)
+        assert r.id == 55 and r.answers[0].address == "192.168.0.1"
+
+    def test_bad_version_closes_connection(self, tmp_path):
+        sock_path = str(tmp_path / "b.sock")
+
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, balancer_socket=sock_path)
+            reader, writer = await asyncio.open_unix_connection(sock_path)
+            frame = bytearray(pack_balancer_frame(4, "1.2.3.4", 1,
+                                                  b"\x00" * 12))
+            frame[4] = 99  # bad version
+            writer.write(bytes(frame))
+            await writer.drain()
+            eof = await reader.read()
+            writer.close()
+            await writer.wait_closed()
+            await server.stop()
+            return eof
+
+        assert asyncio.run(run()) == b""
+
+
+class TestMetrics:
+    def test_counters_and_latency(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            await udp_ask(server.udp_port, "web.foo.com", Type.A)
+            await udp_ask(server.udp_port, "web.foo.com", Type.A)
+            await udp_ask(server.udp_port, "1.0.168.192.in-addr.arpa",
+                          Type.PTR)
+            # let 'after' hooks run
+            await asyncio.sleep(0)
+            counter = server.collector.get(METRIC_REQUEST_COUNTER)
+            hist = server.collector.get(METRIC_LATENCY_HISTOGRAM)
+            exposed = server.collector.expose()
+            await server.stop()
+            return counter, hist, exposed
+
+        counter, hist, exposed = asyncio.run(run())
+        assert counter.value({"type": "A"}) == 2
+        assert counter.value({"type": "PTR"}) == 1
+        assert hist.count({"type": "A"}) == 2
+        assert 'binder_requests_completed{type="A"} 2' in exposed
+        assert "binder_request_latency_seconds_bucket" in exposed
+
+
+class TestReviewRegressions:
+    """Regressions from the second code-review pass."""
+
+    def test_async_handler_path_works(self):
+        """A handler that returns a real awaitable (the recursion shape)
+        must complete, not die with a half-driven coroutine."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+
+            orig = server.resolver.handle
+
+            def handle(query):
+                async def delayed():
+                    await asyncio.sleep(0.01)  # real suspension
+                    pending = orig(query)
+                    if pending is not None:
+                        await pending
+                return delayed()
+
+            server.resolver.handle = handle
+            server.engine.on_query = lambda q: server.resolver.handle(q)
+            r = await udp_ask(server.udp_port, "web.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "192.168.0.1"
+
+    def test_unencodable_record_yields_servfail(self):
+        """host record without an address: client must get SERVFAIL, not
+        silence."""
+        async def run():
+            store, cache = fixture_store()
+            store.put_json("/com/foo/noaddr", {"type": "host", "host": {}})
+            server = await start_server(cache)
+            r = await udp_ask(server.udp_port, "noaddr.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.SERVFAIL and not r.answers
+
+    def test_balancer_udp_transport_truncates(self, tmp_path):
+        sock_path = str(tmp_path / "b.sock")
+
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, balancer_socket=sock_path)
+            reader, writer = await asyncio.open_unix_connection(sock_path)
+            q = make_query("svc.foo.com", Type.A, qid=9,
+                           edns_payload=None).encode()
+            from binder_tpu.dns.server import TRANSPORT_TCP, TRANSPORT_UDP
+            writer.write(pack_balancer_frame(4, "203.0.113.9", 5353, q,
+                                             transport=TRANSPORT_UDP))
+            await writer.drain()
+            (ln,) = struct.unpack(">I", await reader.readexactly(4))
+            *_, payload_udp = unpack_balancer_frame(
+                await reader.readexactly(ln))
+            writer.write(pack_balancer_frame(4, "203.0.113.9", 5353, q,
+                                             transport=TRANSPORT_TCP))
+            await writer.drain()
+            (ln,) = struct.unpack(">I", await reader.readexactly(4))
+            *_, payload_tcp = unpack_balancer_frame(
+                await reader.readexactly(ln))
+            writer.close()
+            await writer.wait_closed()
+            await server.stop()
+            return Message.decode(payload_udp), Message.decode(payload_tcp)
+
+        udp_r, tcp_r = asyncio.run(run())
+        # UDP-origin (no EDNS): truncated at 512; TCP-origin: full answers
+        assert udp_r.tc and not udp_r.answers
+        assert not tcp_r.tc and len(tcp_r.answers) == 40
