@@ -73,6 +73,10 @@ class DnsServer:
         self._tcp_servers: List[asyncio.AbstractServer] = []
         self._unix_servers: List[asyncio.AbstractServer] = []
         self._tasks: set = set()
+        # live stream connections (TCP clients, balancer links) — must be
+        # force-closed on shutdown or Server.wait_closed() blocks on
+        # handlers stuck in read
+        self._conns: set = set()
 
     # -- shared query dispatch --
     #
@@ -192,6 +196,7 @@ class DnsServer:
     async def _tcp_conn(self, reader: asyncio.StreamReader,
                         writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername") or ("?", 0)
+        self._conns.add(writer)
         try:
             while True:
                 hdr = await reader.readexactly(2)
@@ -205,6 +210,7 @@ class DnsServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -221,6 +227,7 @@ class DnsServer:
     async def _balancer_conn(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter) -> None:
         lock = asyncio.Lock()
+        self._conns.add(writer)
         try:
             while True:
                 hdr = await reader.readexactly(4)
@@ -242,10 +249,16 @@ class DnsServer:
                     out = pack_balancer_frame(f, a, p, wire, transport=t)
                     # serialize frame writes from concurrent queries
                     async def _write():
-                        async with lock:
-                            writer.write(out)
-                            await writer.drain()
-                    asyncio.ensure_future(_write())
+                        try:
+                            async with lock:
+                                writer.write(out)
+                                await writer.drain()
+                        except (ConnectionResetError, BrokenPipeError,
+                                OSError):
+                            pass  # balancer went away; response is lost
+                    task = asyncio.ensure_future(_write())
+                    self._tasks.add(task)
+                    task.add_done_callback(self._tasks.discard)
 
                 self._handle_raw(
                     payload, (addr, port), "balancer", send,
@@ -254,6 +267,7 @@ class DnsServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -265,6 +279,8 @@ class DnsServer:
     async def close(self) -> None:
         for t in self._udp_transports:
             t.close()
+        for w in list(self._conns):
+            w.close()
         for s in self._tcp_servers + self._unix_servers:
             s.close()
             await s.wait_closed()

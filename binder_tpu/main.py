@@ -97,7 +97,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
             region_name=rcfg.get("regionName", ""),
             datacenter_name=str(options.get("datacenterName", "")),
             dns_domain=str(options["dnsDomain"]),
-            ufds=rcfg.get("ufds") or {},
+            # static per-DC resolver lists may live at recursion.dcs or
+            # recursion.ufds.dcs; a real UFDS/LDAP source plugs in here
+            ufds=rcfg.get("ufds") or rcfg,
         )
         await recursion.wait_ready()
 

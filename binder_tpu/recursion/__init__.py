@@ -1,0 +1,10 @@
+"""Cross-datacenter recursion (port of lib/recursion.js)."""
+from binder_tpu.recursion.client import (  # noqa: F401
+    DnsClient,
+    UpstreamError,
+)
+from binder_tpu.recursion.recursion import (  # noqa: F401
+    Recursion,
+    ResolverSource,
+    StaticResolverSource,
+)

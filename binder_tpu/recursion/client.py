@@ -1,0 +1,144 @@
+"""Async DNS client for upstream queries (mname-client equivalent).
+
+The reference forwards cross-DC queries with mname-client's DnsClient
+(``lib/recursion.js:64-79,253-279``): bounded concurrency across the
+resolver list, 3s timeout, first NOERROR response wins, and for PTR
+fan-out an error threshold equal to the whole resolver list.  This module
+reimplements that surface on asyncio with our own wire codec.
+
+Resolvers may be given as ``"ip"`` (port 53) or ``"ip:port"`` (tests,
+non-standard deployments).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import List, Optional, Sequence, Tuple
+
+from binder_tpu.dns.wire import Message, Rcode, Record, make_query
+
+DEFAULT_TIMEOUT = 3.0  # lib/recursion.js:257
+
+
+class UpstreamError(Exception):
+    """No upstream produced a usable answer."""
+
+
+def _parse_resolver(r: str) -> Tuple[str, int]:
+    if r.startswith("["):  # [v6]:port
+        host, _, port = r[1:].partition("]:")
+        return host, int(port or 53)
+    if r.count(":") == 1:
+        host, _, port = r.partition(":")
+        return host, int(port)
+    return r, 53
+
+
+class DnsClient:
+    """Queries a set of upstream resolvers with bounded concurrency."""
+
+    def __init__(self, concurrency: int = 2,
+                 timeout: float = DEFAULT_TIMEOUT,
+                 log: Optional[logging.Logger] = None) -> None:
+        self.concurrency = concurrency
+        self.timeout = timeout
+        self.log = log or logging.getLogger("binder.dnsclient")
+
+    async def lookup(self, name: str, qtype: int,
+                     resolvers: Sequence[str],
+                     error_threshold: Optional[int] = None
+                     ) -> List[Record]:
+        """Return the answers from the first NOERROR upstream response.
+
+        Tries *resolvers* with at most ``concurrency`` queries in flight;
+        gives up once ``error_threshold`` upstreams have failed (default:
+        all of them, matching mname-client's behavior of walking the whole
+        list).
+        """
+        if not resolvers:
+            raise UpstreamError("no upstream resolvers")
+        threshold = (len(resolvers) if error_threshold is None
+                     else error_threshold)
+
+        sem = asyncio.Semaphore(self.concurrency)
+        errors: List[str] = []
+        done_count = [0]
+        winner: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        async def one(resolver: str) -> None:
+            try:
+                async with sem:
+                    if winner.done():
+                        return
+                    try:
+                        msg = await self._query_one(name, qtype, resolver)
+                    except Exception as e:  # noqa: BLE001 — any failure
+                        # counts against the threshold; an uncounted error
+                        # (e.g. a malformed resolver string) would hang
+                        # the lookup forever
+                        errors.append(f"{resolver}: {e}")
+                    else:
+                        if msg.rcode == Rcode.NOERROR:
+                            if not winner.done():
+                                winner.set_result(msg.answers)
+                            return
+                        errors.append(f"{resolver}: rcode "
+                                      f"{Rcode.name(msg.rcode)}")
+                    if len(errors) >= threshold and not winner.done():
+                        winner.set_exception(UpstreamError(
+                            "; ".join(errors[-4:])))
+            finally:
+                done_count[0] += 1
+                if done_count[0] == len(resolvers) and not winner.done():
+                    winner.set_exception(UpstreamError(
+                        "; ".join(errors[-4:]) or "all upstreams failed"))
+
+        tasks = [asyncio.ensure_future(one(r)) for r in resolvers]
+        try:
+            return await winner
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _query_one(self, name: str, qtype: int,
+                         resolver: str) -> Message:
+        host, port = _parse_resolver(resolver)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        qid = random.randrange(0, 65536)
+        # Forwarded queries must not re-recurse: clear RD
+        # (lib/recursion.js:259-261)
+        query = make_query(name, qtype, qid=qid, rd=False)
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+                transport.sendto(query.encode())
+
+            def datagram_received(self, data, addr):
+                try:
+                    msg = Message.decode(data)
+                except Exception as e:  # noqa: BLE001
+                    if not fut.done():
+                        fut.set_exception(
+                            WireTimeout(f"bad upstream response: {e}"))
+                    return
+                if msg.id == qid and not fut.done():
+                    fut.set_result(msg)
+
+            def error_received(self, exc):
+                if not fut.done():
+                    fut.set_exception(exc)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, remote_addr=(host, port))
+        try:
+            return await asyncio.wait_for(fut, self.timeout)
+        finally:
+            transport.close()
+
+
+class WireTimeout(Exception):
+    pass

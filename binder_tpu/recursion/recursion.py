@@ -1,0 +1,265 @@
+"""Best-effort cross-datacenter recursive resolution.
+
+Port of the reference's Recursion (``lib/recursion.js``): when a name (or
+PTR address) misses the local cache and the client set RD, forward the
+query to the binders of the datacenter named by the label in front of the
+DNS domain — or, for PTR, to every binder we know of in parallel
+(``lib/recursion.js:335-354``).
+
+Structure preserved:
+- **Resolver discovery** refreshes every 5 minutes (``:40,150-171``) from a
+  pluggable source.  The reference hardcodes UFDS/LDAP (``listResolvers``);
+  here that's the ``ResolverSource`` interface, with a config-driven
+  ``StaticResolverSource`` and the UFDS shape left to deployments with an
+  LDAP directory (SURVEY §7.1 step 6 calls for exactly this interface).
+- **Best-effort init**: first discovery failure retries every 15 s forever
+  and the service comes up anyway (``:183-196``); discovery errors after
+  that are logged, never fatal (``:160-165``).
+- **Self-filtering**: upstream addresses matching local NICs are dropped
+  (30 s cached NIC list) so we don't recurse into ourselves (``:356-376``).
+- **Answer rebuild**: upstream answers are re-added under the original
+  query name, by record type, dropping unsupported types (``:299-323``);
+  zero answers → REFUSED, same failover policy as the engine (``:292-296``).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.dns.wire import (
+    AAAARecord,
+    ARecord,
+    CNAMERecord,
+    PTRRecord,
+    Rcode,
+    Record,
+    SRVRecord,
+    TXTRecord,
+    Type,
+)
+from binder_tpu.recursion.client import DnsClient, UpstreamError
+from binder_tpu.utils import netif
+
+REFRESH_INTERVAL = 300.0   # 5 min (lib/recursion.js:40)
+INIT_RETRY = 15.0          # lib/recursion.js:190
+NIC_CACHE_TTL = 30.0       # lib/recursion.js:363
+PTR_CONCURRENCY = 100      # lib/recursion.js:76-78
+
+
+def _host_of(resolver: str) -> str:
+    """Host part of 'ip', 'ip:port', or '[v6]:port' — bare IPv6 addresses
+    contain colons and must not be split."""
+    if resolver.startswith("["):
+        return resolver[1:resolver.index("]")]
+    if resolver.count(":") == 1:
+        return resolver.partition(":")[0]
+    return resolver
+
+
+class ResolverSource:
+    """Discovery interface: where do other datacenters' binders live?
+
+    The reference implements this against UFDS:
+    ``sdc-ldap search -b 'region=<region>, o=smartdc' objectclass=resolver``
+    (``lib/recursion.js:16-19,202-219``).
+    """
+
+    async def init(self, zk_cache) -> None:
+        """One-time bootstrap; may use the local cache (the reference
+        resolves UFDS's own address through binder's ZK mirror,
+        ``lib/recursion.js:105-127``).  Raise to trigger the 15 s retry."""
+
+    async def list_resolvers(self, region_name: str) -> List[Dict[str, str]]:
+        """Return [{"datacenter": dc, "ip": addr}, ...]."""
+        raise NotImplementedError
+
+
+class StaticResolverSource(ResolverSource):
+    """Config-driven source: {"dc-name": ["ip", ...], ...}."""
+
+    def __init__(self, dcs: Dict[str, Sequence[str]]) -> None:
+        self._dcs = dcs
+
+    async def list_resolvers(self, region_name: str) -> List[Dict[str, str]]:
+        return [{"datacenter": dc, "ip": ip}
+                for dc, ips in self._dcs.items() for ip in ips]
+
+
+class Recursion:
+    def __init__(self, *, zk_cache, dns_domain: str, datacenter_name: str,
+                 region_name: str = "",
+                 source: Optional[ResolverSource] = None,
+                 ufds: Optional[dict] = None,
+                 log: Optional[logging.Logger] = None,
+                 nic_provider=netif.local_addresses,
+                 client: Optional[DnsClient] = None,
+                 ptr_client: Optional[DnsClient] = None) -> None:
+        self.zk_cache = zk_cache
+        self.dns_domain = dns_domain.lower()
+        self.datacenter_name = datacenter_name
+        self.region_name = region_name
+        self.log = log or logging.getLogger("binder.recursion")
+        if source is None:
+            if ufds is not None and "dcs" in (ufds or {}):
+                source = StaticResolverSource(ufds["dcs"])
+            else:
+                source = StaticResolverSource({})
+        self.source = source
+        self.nic_provider = nic_provider
+        self.nsc = client or DnsClient(concurrency=2)
+        # PTR fans out to every binder in parallel (lib/recursion.js:67-78)
+        self.nsc_max = ptr_client or DnsClient(concurrency=PTR_CONCURRENCY)
+
+        self.dcs: Dict[str, List[str]] = {}
+        self._ready = asyncio.Event()
+        self._nics: Optional[List[str]] = None
+        self._nics_at = 0.0
+        self._bg: List[asyncio.Task] = []
+        self._closed = False
+        try:
+            asyncio.get_running_loop()
+            self._spawn(self._init())
+        except RuntimeError:
+            pass  # no loop yet; caller drives via wait_ready()
+
+    # -- lifecycle --
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg.append(task)
+
+    async def wait_ready(self) -> None:
+        if not self._bg and not self._ready.is_set():
+            self._spawn(self._init())
+        await self._ready.wait()
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._bg:
+            t.cancel()
+        await asyncio.gather(*self._bg, return_exceptions=True)
+
+    async def _init(self) -> None:
+        """Best-effort client init with 15 s retry
+        (lib/recursion.js:93-198)."""
+        while not self._closed:
+            try:
+                await self.source.init(self.zk_cache)
+                await self.refresh()
+            except Exception as e:  # noqa: BLE001 — best effort by design
+                self.log.warning(
+                    "Recursion: configured for recursive dns but unable to "
+                    "initialize (%s); will try again in %ss, continuing "
+                    "since recursive resolves are best effort", e,
+                    INIT_RETRY)
+                self._ready.set()
+                await asyncio.sleep(INIT_RETRY)
+                continue
+            self.log.info("Recursion: done initing clients")
+            self._ready.set()
+            self._spawn(self._refresh_loop())
+            return
+
+    async def _refresh_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(REFRESH_INTERVAL)
+            try:
+                await self.refresh()
+            except Exception as e:  # noqa: BLE001
+                self.log.error("Recursion: error on refresh: %s", e)
+
+    async def refresh(self) -> None:
+        """Re-pull the per-DC resolver map (lib/recursion.js:202-249)."""
+        resolvers = await self.source.list_resolvers(self.region_name)
+        dcs: Dict[str, List[str]] = {}
+        for r in resolvers:
+            ips = dcs.setdefault(r["datacenter"], [])
+            if r["ip"] not in ips:
+                ips.append(r["ip"])
+        self.log.debug("Recursion: setting recursion resolvers: %r", dcs)
+        self.dcs = dcs
+
+    # -- the resolve path (lib/recursion.js:287-388) --
+
+    def _my_addrs(self) -> List[str]:
+        now = time.monotonic()
+        if self._nics is None or now - self._nics_at > NIC_CACHE_TTL:
+            self._nics = list(self.nic_provider())
+            self._nics_at = now
+        return self._nics
+
+    async def resolve(self, query: QueryCtx) -> None:
+        # decode_name lowercases wire names already; normalize again in
+        # case a caller hands us a hand-built query (0x20-style mixed case)
+        domain = query.name().lower()
+        answers: List[Record] = []
+
+        is_ptr = query.qtype() == Type.PTR
+
+        def respond() -> None:
+            if not answers:
+                # see the REFUSED comment in the engine
+                query.set_error(Rcode.REFUSED)
+            else:
+                for rec in answers:
+                    rebuilt = self._rebuild(domain, rec)
+                    if rebuilt is not None:
+                        query.add_answer(rebuilt)
+                if not query.response.answers:
+                    query.set_error(Rcode.REFUSED)
+            query.respond()
+
+        if not is_ptr and not domain.endswith(self.dns_domain):
+            # never forward names outside our domain to public DNS
+            respond()
+            return
+
+        if not is_ptr:
+            prefix = domain[:len(domain) - len(self.dns_domain) - 1]
+            dc = prefix[prefix.rfind(".") + 1:]
+            if dc not in self.dcs:
+                respond()
+                return
+            upstreams = list(self.dcs[dc])
+        else:
+            upstreams = [ip for ips in self.dcs.values() for ip in ips]
+
+        my_addrs = self._my_addrs()
+        upstreams = [u for u in upstreams
+                     if _host_of(u) not in my_addrs]
+        if not upstreams:
+            respond()
+            return
+
+        nsc = self.nsc_max if is_ptr else self.nsc
+        try:
+            answers = await nsc.lookup(
+                domain, query.qtype(), upstreams,
+                error_threshold=len(upstreams) if is_ptr else None)
+        except UpstreamError as e:
+            self.log.debug("recursion upstream error: %s", e)
+            answers = []
+        respond()
+
+    def _rebuild(self, domain: str, rec: Record) -> Optional[Record]:
+        """Re-create the upstream answer under the original query name,
+        by type (lib/recursion.js:299-323)."""
+        ttl = rec.ttl
+        if isinstance(rec, ARecord):
+            return ARecord(name=domain, ttl=ttl, address=rec.address)
+        if isinstance(rec, AAAARecord):
+            return AAAARecord(name=domain, ttl=ttl, address=rec.address)
+        if isinstance(rec, (PTRRecord, CNAMERecord)):
+            return type(rec)(name=domain, ttl=ttl, target=rec.target)
+        if isinstance(rec, TXTRecord):
+            return TXTRecord(name=domain, ttl=ttl, texts=rec.texts)
+        if isinstance(rec, SRVRecord):
+            return SRVRecord(name=domain, ttl=ttl, priority=rec.priority,
+                             weight=rec.weight, port=rec.port,
+                             target=rec.target)
+        self.log.warning("recursion: upstream returned unsupported record "
+                         "type %s, dropping", type(rec).__name__)
+        return None

@@ -1,0 +1,765 @@
+/*
+ * mbalancer — DNS load balancer fronting N binder backend processes.
+ *
+ * C++ rebuild of the reference's mname-balancer (SURVEY §2.2 L1; the
+ * reference submodule is not vendored, so the wire protocol is our own
+ * spec, docs/balancer-protocol.md).  Behavior match:
+ *
+ *  - owns the public UDP + TCP DNS port and fans queries out to backend
+ *    processes over per-backend UNIX stream sockets found in a socket
+ *    directory (reference: /var/run/binder/sockets, boot/setup.sh);
+ *  - frames carry the ORIGINAL client address + transport so backends
+ *    log/answer as if they received the packet directly;
+ *  - remote-IP -> backend affinity (reference g_remotes AVL,
+ *    bin/balstat:19-31), round-robin assignment of new remotes across
+ *    healthy backends (reference g_backends);
+ *  - backends leave by unlinking their socket (reference main.js:181-193):
+ *    periodic directory rescans pick up joins/leaves; send errors mark a
+ *    backend unhealthy immediately;
+ *  - introspection: JSON state dump served on <sockdir>/.balancer.stats
+ *    (replaces the reference's mdb-based bin/balstat).
+ *
+ * Single-threaded epoll event loop; no allocations on the per-packet path
+ * beyond buffer reuse.  Usage:
+ *     mbalancer -d <sockdir> [-p port] [-b bindaddr] [-s scan_ms]
+ */
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <getopt.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/timerfd.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdarg>
+#include <deque>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kProtoVersion = 1;
+constexpr size_t kFrameHdr = 21;      /* ver+family+transport+addr16+port */
+constexpr size_t kMaxFrame = 65556;
+constexpr uint8_t kTransportUdp = 0;
+constexpr uint8_t kTransportTcp = 1;
+constexpr size_t kMaxUdpPacket = 65535;
+/* Affinity-table cap: the map is keyed by remote host, and mbalancer owns
+ * a public UDP port — without a bound, spoofed source addresses would grow
+ * it until OOM.  On overflow the whole table resets (stickiness is a
+ * best-effort optimization, not a correctness requirement). */
+constexpr size_t kMaxRemotes = 65536;
+
+int g_verbose = 0;
+
+void logmsg(const char *fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    fprintf(stderr, "mbalancer: ");
+    vfprintf(stderr, fmt, ap);
+    fprintf(stderr, "\n");
+    va_end(ap);
+}
+
+void tracemsg(const char *fmt, ...) {
+    if (!g_verbose) return;
+    va_list ap;
+    va_start(ap, fmt);
+    fprintf(stderr, "mbalancer: ");
+    vfprintf(stderr, fmt, ap);
+    fprintf(stderr, "\n");
+    va_end(ap);
+}
+
+uint64_t now_ms() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+/* ---- client address key: family + 16 bytes + port ---- */
+struct ClientKey {
+    uint8_t family;
+    uint8_t addr[16];
+    uint16_t port;
+    bool operator==(const ClientKey &o) const {
+        return family == o.family && port == o.port &&
+               memcmp(addr, o.addr, 16) == 0;
+    }
+};
+struct ClientKeyHash {
+    size_t operator()(const ClientKey &k) const {
+        size_t h = 1469598103934665603ULL;
+        auto mix = [&h](uint8_t b) { h ^= b; h *= 1099511628211ULL; };
+        mix(k.family);
+        for (int i = 0; i < 16; i++) mix(k.addr[i]);
+        mix(k.port & 0xff);
+        mix(k.port >> 8);
+        return h;
+    }
+};
+
+ClientKey key_from_sockaddr(const struct sockaddr_storage &ss) {
+    ClientKey k{};
+    if (ss.ss_family == AF_INET) {
+        auto *sin = (const struct sockaddr_in *)&ss;
+        k.family = 4;
+        memcpy(k.addr, &sin->sin_addr, 4);
+        k.port = ntohs(sin->sin_port);
+    } else {
+        auto *sin6 = (const struct sockaddr_in6 *)&ss;
+        k.family = 6;
+        memcpy(k.addr, &sin6->sin6_addr, 16);
+        k.port = ntohs(sin6->sin6_port);
+    }
+    return k;
+}
+
+void sockaddr_from_key(const ClientKey &k, struct sockaddr_storage *ss,
+                       socklen_t *len) {
+    memset(ss, 0, sizeof(*ss));
+    if (k.family == 4) {
+        auto *sin = (struct sockaddr_in *)ss;
+        sin->sin_family = AF_INET;
+        memcpy(&sin->sin_addr, k.addr, 4);
+        sin->sin_port = htons(k.port);
+        *len = sizeof(*sin);
+    } else {
+        auto *sin6 = (struct sockaddr_in6 *)ss;
+        sin6->sin6_family = AF_INET6;
+        memcpy(&sin6->sin6_addr, k.addr, 16);
+        sin6->sin6_port = htons(k.port);
+        *len = sizeof(*sin6);
+    }
+}
+
+/* ---- buffered stream connection (backend or TCP client) ---- */
+struct Stream {
+    int fd = -1;
+    std::vector<uint8_t> rbuf;
+    std::deque<std::vector<uint8_t>> wq;   /* pending writes */
+    size_t wq_off = 0;                     /* offset into wq.front() */
+
+    void queue_write(std::vector<uint8_t> &&data) { wq.push_back(std::move(data)); }
+
+    /* returns false on fatal error */
+    bool flush() {
+        while (!wq.empty()) {
+            const auto &front = wq.front();
+            ssize_t n = write(fd, front.data() + wq_off,
+                              front.size() - wq_off);
+            if (n < 0) {
+                if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+                return false;
+            }
+            wq_off += (size_t)n;
+            if (wq_off == front.size()) {
+                wq.pop_front();
+                wq_off = 0;
+            }
+        }
+        return true;
+    }
+    bool want_write() const { return !wq.empty(); }
+};
+
+/* ---- backend (one binder process behind a UNIX socket) ---- */
+struct Backend {
+    int id = -1;
+    std::string path;          /* socket path */
+    Stream conn;
+    bool healthy = false;
+    bool present = true;       /* socket file still exists */
+    uint64_t forwarded = 0;
+    uint64_t responded = 0;
+    uint64_t connect_failures = 0;
+};
+
+/* ---- TCP client connection state ---- */
+struct TcpClient {
+    Stream conn;
+    ClientKey key;
+};
+
+struct Balancer {
+    std::string sockdir;
+    std::string bind_addr = "0.0.0.0";
+    int port = 53;
+    int scan_ms = 2000;
+
+    int epfd = -1;
+    int udp_fd = -1;
+    int tcp_fd = -1;
+    int stats_fd = -1;
+    int timer_fd = -1;
+
+    std::vector<Backend> backends;
+    std::unordered_map<std::string, int> backend_by_path;
+    std::unordered_map<int, int> backend_by_fd;       /* fd -> index */
+    std::unordered_map<ClientKey, int, ClientKeyHash> remotes; /* affinity */
+    std::unordered_map<int, TcpClient> tcp_clients;   /* fd -> client */
+    std::unordered_map<ClientKey, int, ClientKeyHash> tcp_by_key;
+    int rr_next = 0;
+
+    uint64_t udp_queries = 0, tcp_queries = 0, drops = 0;
+    uint64_t started_at = 0;
+};
+
+Balancer g_bal;
+
+void epoll_add(int fd, uint32_t events, uint64_t tag) {
+    struct epoll_event ev{};
+    ev.events = events;
+    ev.data.u64 = tag;
+    if (epoll_ctl(g_bal.epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+        logmsg("epoll_ctl ADD failed: %s", strerror(errno));
+        exit(1);
+    }
+}
+
+void epoll_mod(int fd, uint32_t events, uint64_t tag) {
+    struct epoll_event ev{};
+    ev.events = events;
+    ev.data.u64 = tag;
+    (void)epoll_ctl(g_bal.epfd, EPOLL_CTL_MOD, fd, &ev);
+}
+
+/* epoll tags: low 32 bits fd, high 32 bits kind */
+enum Kind : uint64_t {
+    KIND_UDP = 1, KIND_TCP_LISTEN, KIND_TCP_CLIENT, KIND_BACKEND,
+    KIND_STATS, KIND_TIMER,
+};
+uint64_t tag(Kind kind, int fd) { return ((uint64_t)kind << 32) | (uint32_t)fd; }
+
+/* ---------------- backend management ---------------- */
+
+void backend_mark_down(Backend &be) {
+    if (be.conn.fd >= 0) {
+        epoll_ctl(g_bal.epfd, EPOLL_CTL_DEL, be.conn.fd, nullptr);
+        g_bal.backend_by_fd.erase(be.conn.fd);
+        close(be.conn.fd);
+        be.conn = Stream();
+    }
+    be.healthy = false;
+}
+
+bool backend_connect(Backend &be) {
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    struct sockaddr_un sun{};
+    sun.sun_family = AF_UNIX;
+    snprintf(sun.sun_path, sizeof(sun.sun_path), "%s", be.path.c_str());
+    if (connect(fd, (struct sockaddr *)&sun, sizeof(sun)) != 0 &&
+        errno != EINPROGRESS) {
+        close(fd);
+        be.connect_failures++;
+        return false;
+    }
+    be.conn = Stream();
+    be.conn.fd = fd;
+    be.healthy = true;   /* optimistic; demoted on first error */
+    g_bal.backend_by_fd[fd] = be.id;
+    epoll_add(fd, EPOLLIN, tag(KIND_BACKEND, fd));
+    tracemsg("backend %d connected at %s", be.id, be.path.c_str());
+    return true;
+}
+
+void scan_sockdir() {
+    DIR *d = opendir(g_bal.sockdir.c_str());
+    if (d == nullptr) {
+        logmsg("cannot open socket dir %s: %s", g_bal.sockdir.c_str(),
+               strerror(errno));
+        return;
+    }
+    for (auto &be : g_bal.backends) be.present = false;
+
+    struct dirent *de;
+    while ((de = readdir(d)) != nullptr) {
+        if (de->d_name[0] == '.') continue;  /* incl. .balancer.stats */
+        std::string path = g_bal.sockdir + "/" + de->d_name;
+        struct stat st;
+        if (stat(path.c_str(), &st) != 0 || !S_ISSOCK(st.st_mode)) continue;
+        auto it = g_bal.backend_by_path.find(path);
+        if (it == g_bal.backend_by_path.end()) {
+            Backend be;
+            be.id = (int)g_bal.backends.size();
+            be.path = path;
+            be.present = true;
+            g_bal.backends.push_back(std::move(be));
+            g_bal.backend_by_path[path] = g_bal.backends.back().id;
+            backend_connect(g_bal.backends.back());
+            logmsg("backend %d added: %s",
+                   g_bal.backends.back().id, path.c_str());
+        } else {
+            Backend &be = g_bal.backends[it->second];
+            be.present = true;
+            if (!be.healthy) backend_connect(be);
+        }
+    }
+    closedir(d);
+
+    /* sockets that vanished: the backend told us it's going away */
+    for (auto &be : g_bal.backends) {
+        if (!be.present && be.healthy) {
+            logmsg("backend %d socket removed, draining", be.id);
+            backend_mark_down(be);
+        }
+    }
+}
+
+int pick_backend(const ClientKey &client) {
+    size_t n = g_bal.backends.size();
+    if (n == 0) return -1;
+
+    /* affinity is per remote host (reference remote_t keeps rem_addr
+     * only), so ignore the source port */
+    ClientKey host = client;
+    host.port = 0;
+
+    auto it = g_bal.remotes.find(host);
+    if (it != g_bal.remotes.end()) {
+        Backend &be = g_bal.backends[it->second];
+        if (be.healthy) return it->second;
+        g_bal.remotes.erase(it);   /* affinity to a dead backend */
+    }
+    /* round-robin over healthy backends */
+    for (size_t i = 0; i < n; i++) {
+        int idx = (g_bal.rr_next + (int)i) % (int)n;
+        if (g_bal.backends[idx].healthy) {
+            g_bal.rr_next = (idx + 1) % (int)n;
+            if (g_bal.remotes.size() >= kMaxRemotes) g_bal.remotes.clear();
+            g_bal.remotes[host] = idx;
+            return idx;
+        }
+    }
+    return -1;
+}
+
+/* ---------------- framing ---------------- */
+
+std::vector<uint8_t> make_frame(const ClientKey &k, uint8_t transport,
+                                const uint8_t *payload, size_t len) {
+    std::vector<uint8_t> out(4 + kFrameHdr + len);
+    uint32_t L = htonl((uint32_t)(kFrameHdr + len));
+    memcpy(out.data(), &L, 4);
+    out[4] = kProtoVersion;
+    out[5] = k.family;
+    out[6] = transport;
+    memcpy(out.data() + 7, k.addr, 16);
+    out[23] = (uint8_t)(k.port >> 8);
+    out[24] = (uint8_t)(k.port & 0xff);
+    memcpy(out.data() + 25, payload, len);
+    return out;
+}
+
+void forward_query(const ClientKey &client, uint8_t transport,
+                   const uint8_t *payload, size_t len) {
+    int idx = pick_backend(client);
+    if (idx < 0) {
+        g_bal.drops++;
+        tracemsg("no healthy backend, dropping query");
+        return;
+    }
+    Backend &be = g_bal.backends[idx];
+    be.conn.queue_write(make_frame(client, transport, payload, len));
+    be.forwarded++;
+    if (!be.conn.flush()) {
+        logmsg("backend %d write error: %s", be.id, strerror(errno));
+        backend_mark_down(be);
+        g_bal.drops++;
+        return;
+    }
+    if (be.conn.want_write())
+        epoll_mod(be.conn.fd, EPOLLIN | EPOLLOUT, tag(KIND_BACKEND, be.conn.fd));
+}
+
+/* ---------------- fronts ---------------- */
+
+void handle_udp() {
+    uint8_t buf[kMaxUdpPacket];
+    for (;;) {
+        struct sockaddr_storage ss{};
+        socklen_t slen = sizeof(ss);
+        ssize_t n = recvfrom(g_bal.udp_fd, buf, sizeof(buf), 0,
+                             (struct sockaddr *)&ss, &slen);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+            logmsg("udp recv error: %s", strerror(errno));
+            return;
+        }
+        if (n < 12) continue;      /* shorter than a DNS header */
+        g_bal.udp_queries++;
+        forward_query(key_from_sockaddr(ss), kTransportUdp, buf, (size_t)n);
+    }
+}
+
+void tcp_client_close(int fd) {
+    auto it = g_bal.tcp_clients.find(fd);
+    if (it != g_bal.tcp_clients.end()) {
+        g_bal.tcp_by_key.erase(it->second.key);
+        g_bal.tcp_clients.erase(it);
+    }
+    epoll_ctl(g_bal.epfd, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+}
+
+void handle_tcp_accept() {
+    for (;;) {
+        struct sockaddr_storage ss{};
+        socklen_t slen = sizeof(ss);
+        int fd = accept4(g_bal.tcp_fd, (struct sockaddr *)&ss, &slen,
+                         SOCK_NONBLOCK);
+        if (fd < 0) return;
+        TcpClient tc;
+        tc.conn.fd = fd;
+        tc.key = key_from_sockaddr(ss);
+        g_bal.tcp_clients[fd] = std::move(tc);
+        g_bal.tcp_by_key[g_bal.tcp_clients[fd].key] = fd;
+        epoll_add(fd, EPOLLIN, tag(KIND_TCP_CLIENT, fd));
+    }
+}
+
+void handle_tcp_client(int fd, uint32_t events) {
+    auto it = g_bal.tcp_clients.find(fd);
+    if (it == g_bal.tcp_clients.end()) return;
+    TcpClient &tc = it->second;
+
+    if (events & (EPOLLHUP | EPOLLERR)) {
+        tcp_client_close(fd);
+        return;
+    }
+    if (events & EPOLLOUT) {
+        if (!tc.conn.flush()) {
+            tcp_client_close(fd);
+            return;
+        }
+        if (!tc.conn.want_write())
+            epoll_mod(fd, EPOLLIN, tag(KIND_TCP_CLIENT, fd));
+    }
+    if (!(events & EPOLLIN)) return;
+
+    uint8_t buf[16384];
+    for (;;) {
+        ssize_t n = read(fd, buf, sizeof(buf));
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            tcp_client_close(fd);
+            return;
+        }
+        if (n == 0) {
+            tcp_client_close(fd);
+            return;
+        }
+        auto &rb = tc.conn.rbuf;
+        rb.insert(rb.end(), buf, buf + n);
+        /* RFC 1035 4.2.2 framing: u16 length + message */
+        size_t off = 0;
+        while (rb.size() - off >= 2) {
+            uint16_t mlen = (uint16_t)((rb[off] << 8) | rb[off + 1]);
+            if (rb.size() - off - 2 < mlen) break;
+            g_bal.tcp_queries++;
+            forward_query(tc.key, kTransportTcp, rb.data() + off + 2, mlen);
+            off += 2 + mlen;
+        }
+        if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
+        if (rb.size() > kMaxFrame) {  /* garbage flood */
+            tcp_client_close(fd);
+            return;
+        }
+    }
+}
+
+/* ---------------- backend responses ---------------- */
+
+void route_response(uint8_t family, uint8_t transport,
+                    const uint8_t *addr16, uint16_t port,
+                    const uint8_t *payload, size_t len) {
+    ClientKey k{};
+    k.family = family;
+    memcpy(k.addr, addr16, 16);
+    k.port = port;
+
+    if (transport == kTransportUdp) {
+        struct sockaddr_storage ss;
+        socklen_t slen;
+        sockaddr_from_key(k, &ss, &slen);
+        (void)sendto(g_bal.udp_fd, payload, len, 0,
+                     (struct sockaddr *)&ss, slen);
+    } else {
+        auto it = g_bal.tcp_by_key.find(k);
+        if (it == g_bal.tcp_by_key.end()) {
+            g_bal.drops++;   /* client went away */
+            return;
+        }
+        TcpClient &tc = g_bal.tcp_clients[it->second];
+        std::vector<uint8_t> out(2 + len);
+        out[0] = (uint8_t)(len >> 8);
+        out[1] = (uint8_t)(len & 0xff);
+        memcpy(out.data() + 2, payload, len);
+        tc.conn.queue_write(std::move(out));
+        if (!tc.conn.flush()) {
+            tcp_client_close(it->second);
+            return;
+        }
+        if (tc.conn.want_write())
+            epoll_mod(tc.conn.fd, EPOLLIN | EPOLLOUT,
+                      tag(KIND_TCP_CLIENT, tc.conn.fd));
+    }
+}
+
+void handle_backend(int fd, uint32_t events) {
+    auto it = g_bal.backend_by_fd.find(fd);
+    if (it == g_bal.backend_by_fd.end()) return;
+    Backend &be = g_bal.backends[it->second];
+
+    if (events & (EPOLLHUP | EPOLLERR)) {
+        logmsg("backend %d connection lost", be.id);
+        backend_mark_down(be);
+        return;
+    }
+    if (events & EPOLLOUT) {
+        if (!be.conn.flush()) {
+            backend_mark_down(be);
+            return;
+        }
+        if (!be.conn.want_write())
+            epoll_mod(fd, EPOLLIN, tag(KIND_BACKEND, fd));
+    }
+    if (!(events & EPOLLIN)) return;
+
+    uint8_t buf[16384];
+    for (;;) {
+        ssize_t n = read(fd, buf, sizeof(buf));
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            logmsg("backend %d read error: %s", be.id, strerror(errno));
+            backend_mark_down(be);
+            return;
+        }
+        if (n == 0) {
+            logmsg("backend %d closed connection", be.id);
+            backend_mark_down(be);
+            return;
+        }
+        auto &rb = be.conn.rbuf;
+        rb.insert(rb.end(), buf, buf + n);
+        size_t off = 0;
+        while (rb.size() - off >= 4) {
+            uint32_t L;
+            memcpy(&L, rb.data() + off, 4);
+            L = ntohl(L);
+            if (L < kFrameHdr || L > kMaxFrame) {
+                logmsg("backend %d protocol error (frame len %u)", be.id, L);
+                backend_mark_down(be);
+                return;
+            }
+            if (rb.size() - off - 4 < L) break;
+            const uint8_t *f = rb.data() + off + 4;
+            if (f[0] != kProtoVersion) {
+                logmsg("backend %d protocol version %u", be.id, f[0]);
+                backend_mark_down(be);
+                return;
+            }
+            uint16_t port = (uint16_t)((f[19] << 8) | f[20]);
+            be.responded++;
+            route_response(f[1], f[2], f + 3, port, f + kFrameHdr,
+                           L - kFrameHdr);
+            off += 4 + L;
+        }
+        if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
+    }
+}
+
+/* ---------------- stats socket ---------------- */
+
+void handle_stats() {
+    for (;;) {
+        int fd = accept4(g_bal.stats_fd, nullptr, nullptr, SOCK_NONBLOCK);
+        if (fd < 0) return;
+        std::string out = "{\n";
+        char line[256];
+        snprintf(line, sizeof(line),
+                 "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
+                 "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
+                 "  \"remotes\": %zu,\n  \"backends\": [\n",
+                 (unsigned long long)(now_ms() - g_bal.started_at),
+                 (unsigned long long)g_bal.udp_queries,
+                 (unsigned long long)g_bal.tcp_queries,
+                 (unsigned long long)g_bal.drops, g_bal.remotes.size());
+        out += line;
+        for (size_t i = 0; i < g_bal.backends.size(); i++) {
+            const Backend &be = g_bal.backends[i];
+            snprintf(line, sizeof(line),
+                     "    {\"id\": %d, \"path\": \"%s\", \"healthy\": %s, "
+                     "\"forwarded\": %llu, \"responded\": %llu}%s\n",
+                     be.id, be.path.c_str(), be.healthy ? "true" : "false",
+                     (unsigned long long)be.forwarded,
+                     (unsigned long long)be.responded,
+                     i + 1 < g_bal.backends.size() ? "," : "");
+            out += line;
+        }
+        out += "  ]\n}\n";
+        (void)write(fd, out.data(), out.size());
+        close(fd);
+    }
+}
+
+/* ---------------- setup ---------------- */
+
+int listen_udp() {
+    int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) { perror("socket udp"); exit(1); }
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in sin{};
+    sin.sin_family = AF_INET;
+    sin.sin_port = htons((uint16_t)g_bal.port);
+    inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr);
+    if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
+        perror("bind udp");
+        exit(1);
+    }
+    return fd;
+}
+
+int listen_tcp() {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) { perror("socket tcp"); exit(1); }
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in sin{};
+    sin.sin_family = AF_INET;
+    sin.sin_port = htons((uint16_t)g_bal.port);
+    inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr);
+    if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
+        perror("bind tcp");
+        exit(1);
+    }
+    if (listen(fd, 128) != 0) { perror("listen tcp"); exit(1); }
+    return fd;
+}
+
+int listen_stats() {
+    std::string path = g_bal.sockdir + "/.balancer.stats";
+    unlink(path.c_str());
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) { perror("socket stats"); exit(1); }
+    struct sockaddr_un sun{};
+    sun.sun_family = AF_UNIX;
+    snprintf(sun.sun_path, sizeof(sun.sun_path), "%s", path.c_str());
+    if (bind(fd, (struct sockaddr *)&sun, sizeof(sun)) != 0 ||
+        listen(fd, 16) != 0) {
+        perror("bind stats");
+        exit(1);
+    }
+    return fd;
+}
+
+void report_port() {
+    /* with -p 0 (tests), report the kernel-chosen port on stdout */
+    struct sockaddr_in sin{};
+    socklen_t slen = sizeof(sin);
+    getsockname(g_bal.udp_fd, (struct sockaddr *)&sin, &slen);
+    printf("PORT %d\n", ntohs(sin.sin_port));
+    fflush(stdout);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    int c;
+    while ((c = getopt(argc, argv, "d:p:b:s:v")) != -1) {
+        switch (c) {
+        case 'd': g_bal.sockdir = optarg; break;
+        case 'p': g_bal.port = atoi(optarg); break;
+        case 'b': g_bal.bind_addr = optarg; break;
+        case 's': g_bal.scan_ms = atoi(optarg); break;
+        case 'v': g_verbose = 1; break;
+        default:
+            fprintf(stderr, "usage: mbalancer -d sockdir [-p port] "
+                            "[-b bindaddr] [-s scan_ms] [-v]\n");
+            return 1;
+        }
+    }
+    if (g_bal.sockdir.empty()) {
+        fprintf(stderr, "mbalancer: -d sockdir is required\n");
+        return 1;
+    }
+    signal(SIGPIPE, SIG_IGN);
+    g_bal.started_at = now_ms();
+
+    g_bal.epfd = epoll_create1(0);
+    g_bal.udp_fd = listen_udp();
+    g_bal.tcp_fd = listen_tcp();
+    g_bal.stats_fd = listen_stats();
+
+    /* Both fronts bind the same port number: if -p 0, rebind TCP to the
+     * UDP-chosen port for parity with production (:53/:53). */
+    if (g_bal.port == 0) {
+        struct sockaddr_in sin{};
+        socklen_t slen = sizeof(sin);
+        getsockname(g_bal.udp_fd, (struct sockaddr *)&sin, &slen);
+        close(g_bal.tcp_fd);
+        g_bal.port = ntohs(sin.sin_port);
+        g_bal.tcp_fd = listen_tcp();
+    }
+
+    g_bal.timer_fd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+    struct itimerspec its{};
+    its.it_interval.tv_sec = g_bal.scan_ms / 1000;
+    its.it_interval.tv_nsec = (g_bal.scan_ms % 1000) * 1000000L;
+    its.it_value = its.it_interval;
+    timerfd_settime(g_bal.timer_fd, 0, &its, nullptr);
+
+    epoll_add(g_bal.udp_fd, EPOLLIN, tag(KIND_UDP, g_bal.udp_fd));
+    epoll_add(g_bal.tcp_fd, EPOLLIN, tag(KIND_TCP_LISTEN, g_bal.tcp_fd));
+    epoll_add(g_bal.stats_fd, EPOLLIN, tag(KIND_STATS, g_bal.stats_fd));
+    epoll_add(g_bal.timer_fd, EPOLLIN, tag(KIND_TIMER, g_bal.timer_fd));
+
+    scan_sockdir();
+    report_port();
+    logmsg("listening on %s:%d (udp+tcp), sockdir %s",
+           g_bal.bind_addr.c_str(), g_bal.port, g_bal.sockdir.c_str());
+
+    struct epoll_event events[64];
+    for (;;) {
+        int n = epoll_wait(g_bal.epfd, events, 64, -1);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            perror("epoll_wait");
+            return 1;
+        }
+        for (int i = 0; i < n; i++) {
+            uint64_t t = events[i].data.u64;
+            Kind kind = (Kind)(t >> 32);
+            int fd = (int)(t & 0xffffffff);
+            switch (kind) {
+            case KIND_UDP: handle_udp(); break;
+            case KIND_TCP_LISTEN: handle_tcp_accept(); break;
+            case KIND_TCP_CLIENT: handle_tcp_client(fd, events[i].events); break;
+            case KIND_BACKEND: handle_backend(fd, events[i].events); break;
+            case KIND_STATS: handle_stats(); break;
+            case KIND_TIMER: {
+                uint64_t expirations;
+                while (read(g_bal.timer_fd, &expirations, 8) == 8) {}
+                scan_sockdir();
+                break;
+            }
+            }
+        }
+    }
+    return 0;
+}

@@ -1,0 +1,224 @@
+"""Integration tests for the native C++ load balancer (native/balancer).
+
+Spawns the real mbalancer binary in front of real Python backend servers
+connected via the balancer protocol — the multi-process topology the
+reference runs in production but never tests (SURVEY §4: "the balancer …
+zero automated tests").
+"""
+import asyncio
+import json
+import os
+import struct
+import subprocess
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+BALANCER = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build", "mbalancer")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BALANCER),
+    reason="mbalancer not built (make -C native)")
+
+
+def make_fixture(tag):
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": f"10.42.0.{tag}"}})
+    store.start_session()
+    return cache
+
+
+async def start_backend(sockdir, instance, tag):
+    server = BinderServer(zk_cache=make_fixture(tag), dns_domain=DOMAIN,
+                          datacenter_name="dc0", host="127.0.0.1", port=0,
+                          balancer_socket=os.path.join(sockdir,
+                                                       str(instance)),
+                          collector=MetricsCollector())
+    await server.start()
+    return server
+
+
+async def start_balancer(sockdir, scan_ms=150):
+    proc = await asyncio.create_subprocess_exec(
+        BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+        "-s", str(scan_ms),
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.DEVNULL)
+    line = await asyncio.wait_for(proc.stdout.readline(), 5)
+    assert line.startswith(b"PORT ")
+    return proc, int(line.split()[1])
+
+
+async def udp_ask(port, name, qtype, qid=1, timeout=5.0, sock=None):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(make_query(name, qtype, qid=qid).encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        data = await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+    return Message.decode(data)
+
+
+async def tcp_ask(port, name, qtype, qid=2):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    wire = make_query(name, qtype, qid=qid).encode()
+    writer.write(struct.pack(">H", len(wire)) + wire)
+    await writer.drain()
+    (ln,) = struct.unpack(">H", await asyncio.wait_for(
+        reader.readexactly(2), 5))
+    data = await reader.readexactly(ln)
+    writer.close()
+    await writer.wait_closed()
+    return Message.decode(data)
+
+
+def read_stats(sockdir):
+    import socket as s
+    c = s.socket(s.AF_UNIX, s.SOCK_STREAM)
+    c.settimeout(2)
+    c.connect(os.path.join(sockdir, ".balancer.stats"))
+    buf = b""
+    while True:
+        chunk = c.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    c.close()
+    return json.loads(buf)
+
+
+class TestBalancer:
+    def test_udp_and_tcp_through_balancer(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            b1 = await start_backend(sockdir, 5301, 1)
+            b2 = await start_backend(sockdir, 5302, 2)
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)  # let the scan connect backends
+                udp_r = await udp_ask(port, "web.foo.com", Type.A)
+                tcp_r = await tcp_ask(port, "web.foo.com", Type.A)
+                stats = read_stats(sockdir)
+            finally:
+                proc.kill()
+                await proc.wait()
+                await b1.stop()
+                await b2.stop()
+            return udp_r, tcp_r, stats
+
+        udp_r, tcp_r, stats = asyncio.run(run())
+        assert udp_r.rcode == Rcode.NOERROR
+        assert udp_r.answers[0].address.startswith("10.42.0.")
+        assert tcp_r.rcode == Rcode.NOERROR
+        assert stats["udp_queries"] == 1 and stats["tcp_queries"] == 1
+        assert len(stats["backends"]) == 2
+        assert all(be["healthy"] for be in stats["backends"])
+
+    def test_failover_when_backend_leaves(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            b1 = await start_backend(sockdir, 5301, 1)
+            b2 = await start_backend(sockdir, 5302, 2)
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)
+                first = await udp_ask(port, "web.foo.com", Type.A, qid=1)
+                served_by = first.answers[0].address
+
+                # the backend that answered leaves: SIGTERM semantics =
+                # unlink socket + stop serving (main.js:181-193)
+                leaving = b1 if served_by.endswith(".1") else b2
+                path = leaving.balancer_socket
+                await leaving.stop()
+                os.unlink(path)
+                await asyncio.sleep(0.5)  # rescan notices
+
+                second = await udp_ask(port, "web.foo.com", Type.A, qid=2)
+                stats = read_stats(sockdir)
+            finally:
+                proc.kill()
+                await proc.wait()
+                for b in (b1, b2):
+                    try:
+                        await b.stop()
+                    except Exception:
+                        pass
+            return served_by, second, stats
+
+        served_by, second, stats = asyncio.run(run())
+        # affinity must be re-pointed to the surviving backend
+        assert second.rcode == Rcode.NOERROR
+        assert second.answers[0].address != served_by
+        healthy = [be for be in stats["backends"] if be["healthy"]]
+        assert len(healthy) == 1
+
+    def test_affinity_sticks_to_one_backend(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            b1 = await start_backend(sockdir, 5301, 1)
+            b2 = await start_backend(sockdir, 5302, 2)
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)
+                addrs = set()
+                for i in range(6):
+                    r = await udp_ask(port, "web.foo.com", Type.A, qid=i)
+                    addrs.add(r.answers[0].address)
+                stats = read_stats(sockdir)
+            finally:
+                proc.kill()
+                await proc.wait()
+                await b1.stop()
+                await b2.stop()
+            return addrs, stats
+
+        addrs, stats = asyncio.run(run())
+        # same client IP -> same backend every time
+        assert len(addrs) == 1
+        counts = sorted(be["forwarded"] for be in stats["backends"])
+        assert counts == [0, 6]
+
+    def test_late_joining_backend_discovered(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.3)
+                stats_before = read_stats(sockdir)
+                backend = await start_backend(sockdir, 5301, 1)
+                await asyncio.sleep(0.4)  # next scan picks it up
+                r = await udp_ask(port, "web.foo.com", Type.A)
+                stats_after = read_stats(sockdir)
+                await backend.stop()
+            finally:
+                proc.kill()
+                await proc.wait()
+            return stats_before, r, stats_after
+
+        before, r, after = asyncio.run(run())
+        assert before["backends"] == []
+        assert r.rcode == Rcode.NOERROR
+        assert len(after["backends"]) == 1

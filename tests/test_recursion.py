@@ -1,0 +1,274 @@
+"""Cross-DC recursion tests — two real in-process binder servers acting as
+remote datacenters.
+
+The reference has ZERO automated tests for lib/recursion.js (SURVEY §4:
+"Recursion … zero automated tests"); this suite covers the forwarding
+matrix it leaves untested.
+"""
+import asyncio
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.recursion import Recursion, StaticResolverSource
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+
+
+def make_remote_fixture(dc, ip):
+    """A remote DC's binder mirrors names under <x>.<dc>.foo.com."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json(f"/com/foo/{dc}", {"type": "service",
+                                      "service": {"port": 53}})
+    store.put_json(f"/com/foo/{dc}/web",
+                   {"type": "host", "host": {"address": ip, "ttl": 44}})
+    store.start_session()
+    return cache
+
+
+async def start_remote(dc, ip):
+    server = BinderServer(zk_cache=make_remote_fixture(dc, ip),
+                          dns_domain=DOMAIN, datacenter_name=dc,
+                          host="127.0.0.1", port=0,
+                          collector=MetricsCollector())
+    await server.start()
+    return server
+
+
+async def start_local(dcs, **rkw):
+    """Local binder with empty cache + recursion to the given dc map."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.start_session()
+    recursion = Recursion(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="local",
+        source=StaticResolverSource(dcs),
+        nic_provider=lambda: [],  # tests use 127.0.0.1 resolvers
+        **rkw)
+    await recursion.wait_ready()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="local", recursion=recursion,
+                          host="127.0.0.1", port=0,
+                          collector=MetricsCollector())
+    await server.start()
+    return server, recursion
+
+
+async def udp_ask(port, name, qtype, rd=True, timeout=5.0):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(make_query(name, qtype, qid=3, rd=rd).encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        data = await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+    return Message.decode(data)
+
+
+class TestForwarding:
+    def test_cross_dc_a_query(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.1")
+            server, recursion = await start_local(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            r = await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+            await server.stop()
+            await recursion.close()
+            await remote.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "10.77.0.1"
+        assert r.answers[0].name == "web.east.foo.com"
+        assert r.answers[0].ttl == 44  # upstream ttl preserved
+
+    def test_unknown_dc_refused(self):
+        async def run():
+            server, recursion = await start_local({"east": ["127.0.0.1:1"]})
+            r = await udp_ask(server.udp_port, "web.west.foo.com", Type.A)
+            await server.stop()
+            await recursion.close()
+            return r
+
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+    def test_no_rd_means_no_recursion(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.1")
+            server, recursion = await start_local(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            r = await udp_ask(server.udp_port, "web.east.foo.com", Type.A,
+                              rd=False)
+            await server.stop()
+            await recursion.close()
+            await remote.stop()
+            return r
+
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+    def test_dead_upstream_refused(self):
+        async def run():
+            # unroutable upstream: rely on the 3s timeout -> use a short one
+            from binder_tpu.recursion import DnsClient
+            server, recursion = await start_local(
+                {"east": ["127.0.0.1:9"]},  # discard port, nothing listens
+                client=DnsClient(concurrency=2, timeout=0.3))
+            r = await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+            await server.stop()
+            await recursion.close()
+            return r
+
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+    def test_upstream_refused_maps_to_refused(self):
+        async def run():
+            # remote knows nothing about this name -> remote REFUSED
+            remote = await start_remote("east", "10.77.0.1")
+            server, recursion = await start_local(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            r = await udp_ask(server.udp_port, "other.east.foo.com", Type.A)
+            await server.stop()
+            await recursion.close()
+            await remote.stop()
+            return r
+
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+
+class TestPtrFanout:
+    def test_ptr_tries_all_dcs(self):
+        async def run():
+            r1 = await start_remote("east", "10.77.0.1")
+            r2 = await start_remote("west", "10.88.0.1")
+            server, recursion = await start_local({
+                "east": [f"127.0.0.1:{r1.udp_port}"],
+                "west": [f"127.0.0.1:{r2.udp_port}"],
+            })
+            # only the west binder can answer this PTR
+            resp = await udp_ask(server.udp_port,
+                                 "1.0.88.10.in-addr.arpa", Type.PTR)
+            await server.stop()
+            await recursion.close()
+            await r1.stop()
+            await r2.stop()
+            return resp
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].target == "web.west.foo.com"
+
+
+class TestSelfFiltering:
+    def test_own_addresses_filtered(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.1")
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.start_session()
+            # NIC provider claims the remote's address is ours
+            recursion = Recursion(
+                zk_cache=cache, dns_domain=DOMAIN, datacenter_name="local",
+                source=StaticResolverSource(
+                    {"east": [f"127.0.0.1:{remote.udp_port}"]}),
+                nic_provider=lambda: ["127.0.0.1"])
+            await recursion.wait_ready()
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="local",
+                                  recursion=recursion, host="127.0.0.1",
+                                  port=0, collector=MetricsCollector())
+            await server.start()
+            r = await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+            await server.stop()
+            await recursion.close()
+            await remote.stop()
+            return r
+
+        # everything filtered -> best-effort gives up with REFUSED
+        assert asyncio.run(run()).rcode == Rcode.REFUSED
+
+    def test_local_addresses_returns_something(self):
+        from binder_tpu.utils.netif import local_addresses
+        addrs = local_addresses()
+        assert "127.0.0.1" in addrs
+
+
+class TestDiscovery:
+    def test_refresh_updates_dc_map(self):
+        async def run():
+            source = StaticResolverSource({"east": ["10.0.0.1"]})
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.start_session()
+            recursion = Recursion(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="local", source=source)
+            await recursion.wait_ready()
+            before = dict(recursion.dcs)
+            source._dcs = {"east": ["10.0.0.1"], "west": ["10.0.0.2"]}
+            await recursion.refresh()
+            after = dict(recursion.dcs)
+            await recursion.close()
+            return before, after
+
+        before, after = asyncio.run(run())
+        assert before == {"east": ["10.0.0.1"]}
+        assert after == {"east": ["10.0.0.1"], "west": ["10.0.0.2"]}
+
+    def test_init_failure_is_best_effort(self):
+        class FailingSource(StaticResolverSource):
+            async def init(self, cache):
+                raise RuntimeError("ufds down")
+
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.start_session()
+            recursion = Recursion(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="local",
+                                  source=FailingSource({}))
+            # must become ready despite init failure (15s retry continues)
+            await asyncio.wait_for(recursion.wait_ready(), timeout=2)
+            await recursion.close()
+            return True
+
+        assert asyncio.run(run())
+
+
+class TestReviewRegressions:
+    """Regressions from the third code-review pass."""
+
+    def test_malformed_resolver_string_fails_fast(self):
+        """A bad resolver entry must produce REFUSED, not a hung lookup."""
+        async def run():
+            from binder_tpu.recursion import DnsClient, UpstreamError
+            client = DnsClient(concurrency=2, timeout=0.5)
+            try:
+                await asyncio.wait_for(
+                    client.lookup("x.foo.com", Type.A, ["10.0.0.1:notaport"]),
+                    timeout=2)
+            except UpstreamError:
+                return "upstream-error"
+            return "no-error"
+
+        assert asyncio.run(run()) == "upstream-error"
+
+    def test_ipv6_resolver_self_filter(self):
+        from binder_tpu.recursion.recursion import _host_of
+        assert _host_of("fd00::1") == "fd00::1"
+        assert _host_of("[fd00::1]:53") == "fd00::1"
+        assert _host_of("10.0.0.1:53") == "10.0.0.1"
+        assert _host_of("10.0.0.1") == "10.0.0.1"
