@@ -79,12 +79,17 @@ class DnsClient:
                         # the lookup forever
                         errors.append(f"{resolver}: {e}")
                     else:
-                        if msg.rcode == Rcode.NOERROR:
+                        if msg.rcode == Rcode.NOERROR and not msg.tc:
                             if not winner.done():
                                 winner.set_result(msg.answers)
                             return
-                        errors.append(f"{resolver}: rcode "
-                                      f"{Rcode.name(msg.rcode)}")
+                        # truncated responses must not win with an empty
+                        # answer set; treat as upstream failure (a TCP
+                        # retry path is the eventual fix)
+                        errors.append(
+                            f"{resolver}: "
+                            + ("truncated" if msg.tc
+                               else f"rcode {Rcode.name(msg.rcode)}"))
                     if len(errors) >= threshold and not winner.done():
                         winner.set_exception(UpstreamError(
                             "; ".join(errors[-4:])))

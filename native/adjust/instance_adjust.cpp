@@ -1,0 +1,459 @@
+/*
+ * instance_adjust — idempotent reconciler for a set of binder instances.
+ *
+ * C++ rebuild of the reference's smf_adjust + smfx + nvlist_equal
+ * (SURVEY §2.2, §3.6): bring the set of running service instances
+ * "<base>-<port>" in line with a plan of N instances on consecutive ports,
+ * creating/configuring/starting the missing ones and stopping/removing the
+ * surplus, with configuration no-op detection so unchanged instances are
+ * not restarted.
+ *
+ * The reference reconciles against illumos SMF (libscf).  This rebuild
+ * reconciles against a portable process-supervision state directory — the
+ * service-manager role the reference delegates to SMF:
+ *
+ *   <statedir>/<name>.props   property group {instance, socket_path, exec}
+ *                             (the config PG smf_adjust writes,
+ *                             src/smf_adjust.c:44,1060-1090)
+ *   <statedir>/<name>.pid     supervised process id
+ *   <statedir>/<name>.log     instance stdout/stderr
+ *
+ * Reconciliation semantics preserved from the reference:
+ *  - planned set built first, existing instances walked and unwanted ones
+ *    marked (smf_adjust.c:964-1015);
+ *  - surplus removed via stop -> poll-until-gone -> delete
+ *    (remove_instance, smf_adjust.c:189-257);
+ *  - per-instance config compared order-insensitively against the current
+ *    property group; identical config skips the restart entirely
+ *    (nvlist_equal no-op detection, smf_adjust.c:337-455);
+ *  - dead-but-registered instances are restarted (flush_status analog,
+ *    smfx.c:242-336);
+ *  - -w waits up to 60s for instances to come online (process alive +
+ *    balancer socket present) (smf_adjust.c:457-544);
+ *  - -r <cmd> runs once after changes, re-publishing metric ports (the
+ *    metric-ports-updater restart, smf_adjust.c:1119-1136).
+ *
+ * Usage:
+ *   instance_adjust -s <statedir> -b <base> -B <baseport> -i <count>
+ *                   -e <exec-template> [-d <sockdir>] [-r <cmd>] [-w] [-n]
+ *
+ * The exec template may contain %P (port), %S (socket path), %N (name).
+ * -n = dry run (print actions only).
+ */
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <getopt.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kStopWaitMs = 10000;    /* disable poll (smf_adjust.c:189) */
+constexpr int kOnlineWaitMs = 60000;  /* -w bound (smf_adjust.c:457) */
+
+struct Options {
+    std::string statedir;
+    std::string base = "binder";
+    int baseport = 5301;
+    int count = -1;
+    std::string exec_template;
+    std::string sockdir;
+    std::string refresh_cmd;
+    bool wait_online = false;
+    bool dry_run = false;
+};
+
+using Props = std::map<std::string, std::string>;
+
+void msleep(int ms) {
+    struct timespec ts = {ms / 1000, (long)(ms % 1000) * 1000000L};
+    while (nanosleep(&ts, &ts) != 0 && errno == EINTR) {}
+}
+
+std::string path_join(const std::string &a, const std::string &b) {
+    return a + "/" + b;
+}
+
+/* ---- property-group file I/O (the SMF config PG analog) ---- */
+
+bool read_props(const std::string &file, Props *out) {
+    FILE *f = fopen(file.c_str(), "r");
+    if (f == nullptr) return false;
+    char line[1024];
+    while (fgets(line, sizeof(line), f) != nullptr) {
+        char *nl = strchr(line, '\n');
+        if (nl) *nl = '\0';
+        char *eq = strchr(line, '=');
+        if (eq == nullptr || line[0] == '#') continue;
+        *eq = '\0';
+        (*out)[line] = eq + 1;
+    }
+    fclose(f);
+    return true;
+}
+
+bool write_props(const std::string &file, const Props &props) {
+    std::string tmp = file + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "w");
+    if (f == nullptr) return false;
+    for (const auto &kv : props)
+        fprintf(f, "%s=%s\n", kv.first.c_str(), kv.second.c_str());
+    fclose(f);
+    return rename(tmp.c_str(), file.c_str()) == 0;
+}
+
+/* order-insensitive structural equality (nvlist_equal analog,
+ * src/nvlist_equal.c:260-304 — two half-subset passes collapsed into the
+ * std::map comparison) */
+bool props_equal(const Props &a, const Props &b) {
+    return a == b;
+}
+
+/* ---- process supervision ---- */
+
+pid_t read_pid(const std::string &pidfile) {
+    FILE *f = fopen(pidfile.c_str(), "r");
+    if (f == nullptr) return -1;
+    long pid = -1;
+    if (fscanf(f, "%ld", &pid) != 1) pid = -1;
+    fclose(f);
+    return (pid_t)pid;
+}
+
+bool process_alive(pid_t pid) {
+    if (pid <= 0) return false;
+    if (kill(pid, 0) != 0 && errno != EPERM) return false;
+    /* a zombie still answers kill(0); treat it as dead (orphans are not
+     * reaped promptly in minimal containers) */
+    char path[64], buf[512];
+    snprintf(path, sizeof(path), "/proc/%d/stat", (int)pid);
+    FILE *f = fopen(path, "r");
+    if (f == nullptr) return false;
+    size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+    fclose(f);
+    buf[n] = '\0';
+    const char *paren = strrchr(buf, ')');
+    if (paren == nullptr || paren[1] == '\0') return true;
+    return paren[2] != 'Z';
+}
+
+std::string substitute(const std::string &tmpl, int port,
+                       const std::string &sock, const std::string &name) {
+    std::string out;
+    for (size_t i = 0; i < tmpl.size(); i++) {
+        if (tmpl[i] == '%' && i + 1 < tmpl.size()) {
+            switch (tmpl[i + 1]) {
+            case 'P': out += std::to_string(port); i++; continue;
+            case 'S': out += sock; i++; continue;
+            case 'N': out += name; i++; continue;
+            default: break;
+            }
+        }
+        out.push_back(tmpl[i]);
+    }
+    return out;
+}
+
+/* ---- one instance ---- */
+
+struct Instance {
+    std::string name;
+    int port = 0;
+    bool planned = false;   /* in the desired set */
+    bool exists = false;    /* props file present */
+};
+
+struct Reconciler {
+    Options opt;
+    std::vector<Instance> insts;
+    bool changed = false;
+
+    std::string props_file(const std::string &n) {
+        return path_join(opt.statedir, n + ".props");
+    }
+    std::string pid_file(const std::string &n) {
+        return path_join(opt.statedir, n + ".pid");
+    }
+    std::string log_file(const std::string &n) {
+        return path_join(opt.statedir, n + ".log");
+    }
+    std::string socket_path(int port) {
+        if (opt.sockdir.empty()) return "";
+        return path_join(opt.sockdir, std::to_string(port));
+    }
+
+    Props desired_props(const Instance &in) {
+        Props p;
+        p["instance"] = std::to_string(in.port);
+        std::string sock = socket_path(in.port);
+        if (!sock.empty()) p["socket_path"] = sock;
+        p["exec"] = substitute(opt.exec_template, in.port, sock, in.name);
+        return p;
+    }
+
+    /* plan + walk (smf_adjust.c:964-1015) */
+    void build_sets() {
+        std::map<std::string, Instance> by_name;
+        for (int i = 0; i < opt.count; i++) {
+            Instance in;
+            in.port = opt.baseport + i;
+            in.name = opt.base + "-" + std::to_string(in.port);
+            in.planned = true;
+            by_name[in.name] = in;
+        }
+        DIR *d = opendir(opt.statedir.c_str());
+        if (d != nullptr) {
+            struct dirent *de;
+            std::string suffix = ".props";
+            while ((de = readdir(d)) != nullptr) {
+                std::string fn = de->d_name;
+                if (fn.size() <= suffix.size() ||
+                    fn.compare(fn.size() - suffix.size(), suffix.size(),
+                               suffix) != 0)
+                    continue;
+                std::string name = fn.substr(0, fn.size() - suffix.size());
+                if (name.compare(0, opt.base.size() + 1, opt.base + "-") != 0)
+                    continue;   /* not ours */
+                auto it = by_name.find(name);
+                if (it == by_name.end()) {
+                    Instance in;       /* unwanted: marked for removal */
+                    in.name = name;
+                    in.exists = true;
+                    by_name[name] = in;
+                } else {
+                    it->second.exists = true;
+                }
+            }
+            closedir(d);
+        }
+        for (auto &kv : by_name) insts.push_back(kv.second);
+    }
+
+    /* stop -> poll -> delete (remove_instance, smf_adjust.c:189-257) */
+    bool remove_instance(const Instance &in) {
+        printf("remove %s\n", in.name.c_str());
+        changed = true;
+        if (opt.dry_run) return true;
+        pid_t pid = read_pid(pid_file(in.name));
+        if (process_alive(pid)) {
+            kill(pid, SIGTERM);
+            int waited = 0;
+            while (process_alive(pid) && waited < kStopWaitMs) {
+                msleep(100);
+                waited += 100;
+            }
+            if (process_alive(pid)) {
+                fprintf(stderr, "instance_adjust: %s did not stop, "
+                                "killing\n", in.name.c_str());
+                kill(pid, SIGKILL);
+                msleep(100);
+            }
+        }
+        unlink(pid_file(in.name).c_str());
+        unlink(props_file(in.name).c_str());
+        return true;
+    }
+
+    bool stop_instance(const Instance &in) {
+        pid_t pid = read_pid(pid_file(in.name));
+        if (!process_alive(pid)) return true;
+        kill(pid, SIGTERM);
+        int waited = 0;
+        while (process_alive(pid) && waited < kStopWaitMs) {
+            msleep(100);
+            waited += 100;
+        }
+        if (process_alive(pid)) kill(pid, SIGKILL);
+        unlink(pid_file(in.name).c_str());
+        return true;
+    }
+
+    /* configure with no-op detection (smf_adjust.c:337-455) */
+    bool configure_instance(const Instance &in, bool *needs_restart,
+                            bool *noop) {
+        Props current, desired = desired_props(in);
+        bool had = read_props(props_file(in.name), &current);
+        if (had && props_equal(current, desired)) {
+            *needs_restart = false;
+            *noop = true;
+            return true;
+        }
+        printf("%s %s\n", had ? "configure" : "create", in.name.c_str());
+        changed = true;
+        *noop = false;
+        *needs_restart = had;   /* fresh instances just start */
+        if (opt.dry_run) return true;
+        return write_props(props_file(in.name), desired);
+    }
+
+    bool start_instance(const Instance &in) {
+        printf("start %s\n", in.name.c_str());
+        changed = true;
+        if (opt.dry_run) return true;
+        Props props;
+        read_props(props_file(in.name), &props);
+        std::string cmd = props["exec"];
+        if (cmd.empty()) {
+            fprintf(stderr, "instance_adjust: %s has no exec\n",
+                    in.name.c_str());
+            return false;
+        }
+        pid_t pid = fork();
+        if (pid < 0) return false;
+        if (pid == 0) {
+            setsid();
+            int logfd = open(log_file(in.name).c_str(),
+                             O_WRONLY | O_CREAT | O_APPEND, 0644);
+            if (logfd >= 0) {
+                dup2(logfd, 1);
+                dup2(logfd, 2);
+                if (logfd > 2) close(logfd);
+            }
+            int devnull = open("/dev/null", O_RDONLY);
+            if (devnull >= 0) {
+                dup2(devnull, 0);
+                if (devnull > 2) close(devnull);
+            }
+            execl("/bin/sh", "sh", "-c", cmd.c_str(), (char *)nullptr);
+            _exit(127);
+        }
+        FILE *f = fopen(pid_file(in.name).c_str(), "w");
+        if (f != nullptr) {
+            fprintf(f, "%d\n", (int)pid);
+            fclose(f);
+        }
+        return true;
+    }
+
+    /* enable + optional online wait (smf_adjust.c:457-544) */
+    bool ensure_running(const Instance &in) {
+        pid_t pid = read_pid(pid_file(in.name));
+        if (process_alive(pid)) return true;
+        if (pid > 0) {
+            /* registered but dead: clear stale state and restart
+             * (flush_status analog) */
+            printf("restore %s\n", in.name.c_str());
+            if (!opt.dry_run) unlink(pid_file(in.name).c_str());
+        }
+        return start_instance(in);
+    }
+
+    bool wait_online(const Instance &in) {
+        int waited = 0;
+        std::string sock = socket_path(in.port);
+        while (waited < kOnlineWaitMs) {
+            pid_t pid = read_pid(pid_file(in.name));
+            bool alive = process_alive(pid);
+            bool sock_ok = sock.empty() || access(sock.c_str(), F_OK) == 0;
+            if (alive && sock_ok) {
+                /* "online" means stably up, not merely spawned: an
+                 * instance that crashes on startup is briefly alive */
+                msleep(500);
+                if (process_alive(pid)) return true;
+            }
+            if (!alive && waited > 1000) break;   /* crashed on startup */
+            msleep(200);
+            waited += 200;
+        }
+        fprintf(stderr, "instance_adjust: %s did not come online\n",
+                in.name.c_str());
+        return false;
+    }
+
+    int run() {
+        build_sets();
+        bool ok = true;
+
+        /* removals first, to free ports/sockets (smf_adjust.c:1025-1039) */
+        for (const auto &in : insts)
+            if (!in.planned) ok &= remove_instance(in);
+
+        for (auto &in : insts) {
+            if (!in.planned) continue;
+            bool needs_restart = false, noop = false;
+            if (!configure_instance(in, &needs_restart, &noop)) {
+                ok = false;
+                continue;
+            }
+            if (needs_restart && !opt.dry_run) stop_instance(in);
+            if (!opt.dry_run) {
+                bool was_running =
+                    process_alive(read_pid(pid_file(in.name)));
+                ok &= ensure_running(in);
+                if (noop && was_running)
+                    printf("unchanged %s\n", in.name.c_str());
+            }
+        }
+
+        if (opt.wait_online && !opt.dry_run) {
+            for (const auto &in : insts)
+                if (in.planned) ok &= wait_online(in);
+        }
+
+        /* metric-ports re-publication hook (smf_adjust.c:1119-1136) */
+        if (changed && !opt.refresh_cmd.empty() && !opt.dry_run) {
+            printf("refresh-hook\n");
+            int rc = system(opt.refresh_cmd.c_str());
+            if (rc != 0) {
+                fprintf(stderr, "instance_adjust: refresh hook exited %d\n",
+                        rc);
+                ok = false;
+            }
+        }
+        return ok ? 0 : 1;
+    }
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    Options opt;
+    int c;
+    while ((c = getopt(argc, argv, "s:b:B:i:e:d:r:wn")) != -1) {
+        switch (c) {
+        case 's': opt.statedir = optarg; break;
+        case 'b': opt.base = optarg; break;
+        case 'B': opt.baseport = atoi(optarg); break;
+        case 'i': opt.count = atoi(optarg); break;
+        case 'e': opt.exec_template = optarg; break;
+        case 'd': opt.sockdir = optarg; break;
+        case 'r': opt.refresh_cmd = optarg; break;
+        case 'w': opt.wait_online = true; break;
+        case 'n': opt.dry_run = true; break;
+        default:
+            fprintf(stderr,
+                    "usage: instance_adjust -s statedir -b base -B baseport "
+                    "-i count -e exec [-d sockdir] [-r cmd] [-w] [-n]\n");
+            return 2;
+        }
+    }
+    if (opt.statedir.empty() || opt.count < 0 ||
+        (opt.exec_template.empty() && !opt.dry_run)) {
+        fprintf(stderr, "instance_adjust: -s, -i and -e are required "
+                        "(max instances: 32, ports %d..%d)\n",
+                opt.baseport, opt.baseport + 31);
+        return 2;
+    }
+    if (opt.count > 32) {   /* reference bound (boot/setup.sh:17) */
+        fprintf(stderr, "instance_adjust: count > 32\n");
+        return 2;
+    }
+    mkdir(opt.statedir.c_str(), 0755);
+    if (!opt.sockdir.empty()) mkdir(opt.sockdir.c_str(), 0755);
+
+    Reconciler rec;
+    rec.opt = opt;
+    return rec.run();
+}

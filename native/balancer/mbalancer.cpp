@@ -220,6 +220,17 @@ struct Balancer {
 
 Balancer g_bal;
 
+/* fds whose close() is deferred to the end of the current epoll batch:
+ * closing mid-batch lets accept4/connect reuse the fd number while stale
+ * queued events for the old owner are still pending, which would dispatch
+ * against (and tear down) the new connection */
+std::vector<int> g_deferred_close;
+
+void defer_close(int fd) {
+    epoll_ctl(g_bal.epfd, EPOLL_CTL_DEL, fd, nullptr);
+    g_deferred_close.push_back(fd);
+}
+
 void epoll_add(int fd, uint32_t events, uint64_t tag) {
     struct epoll_event ev{};
     ev.events = events;
@@ -248,9 +259,8 @@ uint64_t tag(Kind kind, int fd) { return ((uint64_t)kind << 32) | (uint32_t)fd; 
 
 void backend_mark_down(Backend &be) {
     if (be.conn.fd >= 0) {
-        epoll_ctl(g_bal.epfd, EPOLL_CTL_DEL, be.conn.fd, nullptr);
         g_bal.backend_by_fd.erase(be.conn.fd);
-        close(be.conn.fd);
+        defer_close(be.conn.fd);
         be.conn = Stream();
     }
     be.healthy = false;
@@ -412,8 +422,7 @@ void tcp_client_close(int fd) {
         g_bal.tcp_by_key.erase(it->second.key);
         g_bal.tcp_clients.erase(it);
     }
-    epoll_ctl(g_bal.epfd, EPOLL_CTL_DEL, fd, nullptr);
-    close(fd);
+    defer_close(fd);
 }
 
 void handle_tcp_accept() {
@@ -627,7 +636,11 @@ int listen_udp() {
     struct sockaddr_in sin{};
     sin.sin_family = AF_INET;
     sin.sin_port = htons((uint16_t)g_bal.port);
-    inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr);
+    if (inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr) != 1) {
+        fprintf(stderr, "mbalancer: bad bind address '%s'\n",
+                g_bal.bind_addr.c_str());
+        exit(1);
+    }
     if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
         perror("bind udp");
         exit(1);
@@ -643,7 +656,11 @@ int listen_tcp() {
     struct sockaddr_in sin{};
     sin.sin_family = AF_INET;
     sin.sin_port = htons((uint16_t)g_bal.port);
-    inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr);
+    if (inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr) != 1) {
+        fprintf(stderr, "mbalancer: bad bind address '%s'\n",
+                g_bal.bind_addr.c_str());
+        exit(1);
+    }
     if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
         perror("bind tcp");
         exit(1);
@@ -744,6 +761,11 @@ int main(int argc, char **argv) {
         }
         for (int i = 0; i < n; i++) {
             uint64_t t = events[i].data.u64;
+            int evfd = (int)(t & 0xffffffff);
+            bool closed = false;
+            for (int dfd : g_deferred_close)
+                if (dfd == evfd) { closed = true; break; }
+            if (closed) continue;   /* stale event for a dying fd */
             Kind kind = (Kind)(t >> 32);
             int fd = (int)(t & 0xffffffff);
             switch (kind) {
@@ -760,6 +782,8 @@ int main(int argc, char **argv) {
             }
             }
         }
+        for (int dfd : g_deferred_close) close(dfd);
+        g_deferred_close.clear();
     }
     return 0;
 }

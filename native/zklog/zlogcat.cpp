@@ -1,0 +1,525 @@
+/*
+ * zlogcat — forensic decoder for ZooKeeper replicated transaction logs.
+ *
+ * C++ rebuild of the reference's src/zklog.c (SURVEY §2.2): mmap a txnlog,
+ * validate the FileHeader (magic "ZKLG" = 0x5A4B4C47, version 2), walk the
+ * checksummed, length-prefixed transaction records with strict bounds
+ * checking, and print one JSON object per transaction.  Tracks session
+ * lifetimes (createSession -> closeSession) to report durations, and can
+ * dump sessions still open at the end of the log.
+ *
+ * The on-disk format is the public ZooKeeper jute serialization:
+ *   FileHeader { int magic; int version; long dbid; }
+ *   repeated:  [ long adler32 ][ int txnlen ][ txn bytes ][ 0x42 EOR ]
+ *   txn bytes: TxnHeader { long clientId; int cxid; long zxid; long time;
+ *              int type; } + per-type record body.
+ * Preallocated zero padding terminates the walk (txnlen == 0).
+ *
+ * Usage: zlogcat [-t from-to] [-s sessionid] [-z serverid] [-S] <log>...
+ *   -t ms_from-ms_to   only txns inside the time window
+ *   -s 0xID            only txns from one session (clientId)
+ *   -z N               only sessions created on server id N (high byte of
+ *                      the session id)
+ *   -S                 after decoding, dump sessions still open
+ */
+#include <fcntl.h>
+#include <getopt.h>
+#include <inttypes.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x5A4B4C47;  /* "ZKLG" */
+constexpr int kVersion = 2;
+constexpr uint8_t kEor = 0x42;
+
+/* txn types (public ZooKeeper OpCode values) */
+enum TxnType : int32_t {
+    kNotification = 0,
+    kCreate = 1,
+    kDelete = 2,
+    kExists = 3,
+    kGetData = 4,
+    kSetData = 5,
+    kGetACL = 6,
+    kSetACL = 7,
+    kGetChildren = 8,
+    kSync = 9,
+    kPing = 11,
+    kGetChildren2 = 12,
+    kCheck = 13,
+    kMulti = 14,
+    kCreate2 = 15,
+    kReconfig = 16,
+    kCreateContainer = 19,
+    kDeleteContainer = 20,
+    kCreateTTL = 21,
+    kAuth = 100,
+    kSetWatches = 101,
+    kCreateSession = -10,
+    kCloseSession = -11,
+    kError = -1,
+};
+
+const char *txn_type_name(int32_t t) {
+    switch (t) {
+    case kCreate: return "create";
+    case kCreate2: return "create2";
+    case kCreateContainer: return "createContainer";
+    case kCreateTTL: return "createTTL";
+    case kDelete: return "delete";
+    case kDeleteContainer: return "deleteContainer";
+    case kSetData: return "setData";
+    case kSetACL: return "setACL";
+    case kCheck: return "check";
+    case kMulti: return "multi";
+    case kCreateSession: return "createSession";
+    case kCloseSession: return "closeSession";
+    case kError: return "error";
+    default: return "unknown";
+    }
+}
+
+/* ---- bounds-checked big-endian reader over the mmap'd file ---- */
+struct Reader {
+    const uint8_t *data;
+    size_t len;
+    size_t off = 0;
+    bool ok = true;
+
+    bool need(size_t n) {
+        if (!ok || len - off < n) {
+            ok = false;
+            return false;
+        }
+        return true;
+    }
+    uint8_t u8() {
+        if (!need(1)) return 0;
+        return data[off++];
+    }
+    uint32_t u32() {
+        if (!need(4)) return 0;
+        uint32_t v = ((uint32_t)data[off] << 24) |
+                     ((uint32_t)data[off + 1] << 16) |
+                     ((uint32_t)data[off + 2] << 8) | data[off + 3];
+        off += 4;
+        return v;
+    }
+    int32_t i32() { return (int32_t)u32(); }
+    uint64_t u64() {
+        uint64_t hi = u32();
+        return (hi << 32) | u32();
+    }
+    int64_t i64() { return (int64_t)u64(); }
+    /* jute string/buffer: i32 length (-1 = null) + bytes */
+    bool bytes(std::string *out, bool *is_null) {
+        int32_t n = i32();
+        if (!ok) return false;
+        if (n < 0) {
+            *is_null = true;
+            out->clear();
+            return true;
+        }
+        *is_null = false;
+        if ((uint32_t)n > len - off) {
+            ok = false;
+            return false;
+        }
+        out->assign((const char *)data + off, (size_t)n);
+        off += (size_t)n;
+        return true;
+    }
+};
+
+/* ---- JSON string escaping for paths/data ---- */
+void json_escape(const std::string &in, std::string *out) {
+    out->push_back('"');
+    for (unsigned char c : in) {
+        switch (c) {
+        case '"': *out += "\\\""; break;
+        case '\\': *out += "\\\\"; break;
+        case '\n': *out += "\\n"; break;
+        case '\r': *out += "\\r"; break;
+        case '\t': *out += "\\t"; break;
+        default:
+            if (c < 0x20 || c >= 0x7f) {
+                char buf[8];
+                snprintf(buf, sizeof(buf), "\\u%04x", c);
+                *out += buf;
+            } else {
+                out->push_back((char)c);
+            }
+        }
+    }
+    out->push_back('"');
+}
+
+struct Filters {
+    int64_t time_from = -1, time_to = -1;
+    int64_t session = -1;
+    int server_id = -1;      /* high byte of the session id */
+    bool dump_open = false;
+};
+
+struct SessionInfo {
+    int64_t opened_at = 0;
+    int32_t timeout = 0;
+};
+
+struct Stats {
+    uint64_t txns = 0, bad = 0;
+    std::unordered_map<int64_t, SessionInfo> open_sessions;
+};
+
+/* decode one typed txn body into JSON fields appended to *out */
+bool decode_body(Reader *r, int32_t type, std::string *out, int depth);
+
+bool decode_create(Reader *r, std::string *out, bool with_cversion,
+                   bool with_ttl) {
+    std::string path, data;
+    bool null_path, null_data;
+    if (!r->bytes(&path, &null_path) || !r->bytes(&data, &null_data))
+        return false;
+    /* acl vector: i32 count (-1 = null), each {i32 perms, string scheme,
+     * string id} */
+    int32_t nacl = r->i32();
+    for (int32_t i = 0; r->ok && i < nacl; i++) {
+        (void)r->i32();
+        std::string s;
+        bool n;
+        if (!r->bytes(&s, &n) || !r->bytes(&s, &n)) return false;
+    }
+    uint8_t ephemeral = r->u8();
+    /* parentCVersion exists from ZK 3.4 on; older logs omit it */
+    bool have_cversion = with_cversion && r->len - r->off >= 4;
+    int32_t cversion = have_cversion ? r->i32() : 0;
+    int64_t ttl = with_ttl ? r->i64() : 0;
+    if (!r->ok) return false;
+    *out += ", \"path\": ";
+    json_escape(path, out);
+    *out += ", \"dataLen\": " + std::to_string(data.size());
+    /* znode payloads in binder deployments are JSON; show a prefix */
+    std::string preview = data.substr(0, 64);
+    *out += ", \"data\": ";
+    json_escape(preview, out);
+    *out += ", \"ephemeral\": ";
+    *out += ephemeral ? "true" : "false";
+    if (have_cversion)
+        *out += ", \"parentCVersion\": " + std::to_string(cversion);
+    if (with_ttl) *out += ", \"ttl\": " + std::to_string(ttl);
+    return true;
+}
+
+bool decode_body(Reader *r, int32_t type, std::string *out, int depth) {
+    std::string s;
+    bool is_null;
+    switch (type) {
+    case kCreate:
+    case kCreateContainer:
+        return decode_create(r, out, true, false);
+    case kCreate2:
+        return decode_create(r, out, true, false);
+    case kCreateTTL:
+        return decode_create(r, out, true, true);
+    case kDelete:
+    case kDeleteContainer:
+        if (!r->bytes(&s, &is_null)) return false;
+        *out += ", \"path\": ";
+        json_escape(s, out);
+        return true;
+    case kSetData: {
+        std::string data;
+        bool null_data;
+        if (!r->bytes(&s, &is_null) || !r->bytes(&data, &null_data))
+            return false;
+        int32_t version = r->i32();
+        if (!r->ok) return false;
+        *out += ", \"path\": ";
+        json_escape(s, out);
+        *out += ", \"dataLen\": " + std::to_string(data.size());
+        std::string preview = data.substr(0, 64);
+        *out += ", \"data\": ";
+        json_escape(preview, out);
+        *out += ", \"version\": " + std::to_string(version);
+        return true;
+    }
+    case kSetACL: {
+        if (!r->bytes(&s, &is_null)) return false;
+        int32_t nacl = r->i32();
+        for (int32_t i = 0; r->ok && i < nacl; i++) {
+            (void)r->i32();
+            std::string t;
+            bool n;
+            if (!r->bytes(&t, &n) || !r->bytes(&t, &n)) return false;
+        }
+        int32_t version = r->i32();
+        if (!r->ok) return false;
+        *out += ", \"path\": ";
+        json_escape(s, out);
+        *out += ", \"version\": " + std::to_string(version);
+        return true;
+    }
+    case kCheck: {
+        if (!r->bytes(&s, &is_null)) return false;
+        int32_t version = r->i32();
+        if (!r->ok) return false;
+        *out += ", \"path\": ";
+        json_escape(s, out);
+        *out += ", \"version\": " + std::to_string(version);
+        return true;
+    }
+    case kCreateSession: {
+        int32_t timeout = r->i32();
+        if (!r->ok) return false;
+        *out += ", \"timeoutMs\": " + std::to_string(timeout);
+        return true;
+    }
+    case kCloseSession:
+        /* 3.5 and earlier: empty body; 3.6+: vector of paths to delete —
+         * tolerate either by consuming an optional path vector */
+        if (r->len - r->off >= 4) {
+            int32_t n = r->i32();
+            if (r->ok && n >= 0) {
+                for (int32_t i = 0; r->ok && i < n; i++) {
+                    std::string t;
+                    bool isn;
+                    if (!r->bytes(&t, &isn)) return false;
+                }
+            }
+        }
+        return true;
+    case kError: {
+        int32_t err = r->i32();
+        if (!r->ok) return false;
+        *out += ", \"err\": " + std::to_string(err);
+        return true;
+    }
+    case kMulti: {
+        /* vector of Txn { i32 type; buffer data } */
+        int32_t n = r->i32();
+        if (!r->ok || depth > 4) return false;
+        *out += ", \"ops\": [";
+        for (int32_t i = 0; i < n && r->ok; i++) {
+            int32_t sub_type = r->i32();
+            std::string sub;
+            bool isn;
+            if (!r->bytes(&sub, &isn)) return false;
+            Reader sr{(const uint8_t *)sub.data(), sub.size()};
+            if (i) *out += ", ";
+            *out += "{\"type\": \"";
+            *out += txn_type_name(sub_type);
+            *out += "\"";
+            if (!decode_body(&sr, sub_type, out, depth + 1)) return false;
+            *out += "}";
+        }
+        *out += "]";
+        return r->ok;
+    }
+    default:
+        /* unknown type: skip the rest of the record (length-delimited by
+         * the outer walk, so this is safe) */
+        *out += ", \"undecoded\": true";
+        r->off = r->len;
+        return true;
+    }
+}
+
+int session_server_id(int64_t session_id) {
+    return (int)((uint64_t)session_id >> 56) & 0xff;
+}
+
+/* ZooKeeper stores an Adler-32 of the txn bytes as the record checksum */
+uint32_t adler32(const uint8_t *data, size_t len) {
+    uint32_t a = 1, b = 0;
+    for (size_t i = 0; i < len; i++) {
+        a = (a + data[i]) % 65521;
+        b = (b + a) % 65521;
+    }
+    return (b << 16) | a;
+}
+
+bool do_file(const char *fname, const Filters &f, Stats *st) {
+    int fd = open(fname, O_RDONLY);
+    if (fd < 0) {
+        fprintf(stderr, "zlogcat: cannot open %s: %s\n", fname,
+                strerror(errno));
+        return false;
+    }
+    struct stat sb;
+    if (fstat(fd, &sb) != 0 || sb.st_size < 16) {
+        fprintf(stderr, "zlogcat: %s: too short for a txnlog\n", fname);
+        close(fd);
+        return false;
+    }
+    void *map = mmap(nullptr, (size_t)sb.st_size, PROT_READ, MAP_PRIVATE,
+                     fd, 0);
+    close(fd);
+    if (map == MAP_FAILED) {
+        fprintf(stderr, "zlogcat: mmap %s: %s\n", fname, strerror(errno));
+        return false;
+    }
+
+    Reader r{(const uint8_t *)map, (size_t)sb.st_size};
+    uint32_t magic = r.u32();
+    int32_t version = r.i32();
+    int64_t dbid = r.i64();
+    if (!r.ok || magic != kMagic || version != kVersion) {
+        fprintf(stderr,
+                "zlogcat: %s: bad file header (magic 0x%08X version %d)\n",
+                fname, magic, version);
+        munmap(map, (size_t)sb.st_size);
+        return false;
+    }
+    printf("{\"file\": \"%s\", \"dbid\": %" PRId64 "}\n", fname, dbid);
+
+    for (;;) {
+        if (r.len - r.off < 12) break;        /* no room for crc+len */
+        uint64_t crc = r.u64();
+        int32_t txnlen = r.i32();
+        if (txnlen <= 0 || crc == 0) break;   /* preallocated padding */
+        if ((uint32_t)txnlen > r.len - r.off) {
+            fprintf(stderr, "zlogcat: %s: record at offset %zu overruns "
+                            "file (len %d)\n", fname, r.off, txnlen);
+            st->bad++;
+            break;
+        }
+        Reader tr{r.data + r.off, (size_t)txnlen};
+        bool crc_ok = adler32(r.data + r.off, (size_t)txnlen) ==
+                      (uint32_t)crc;
+        r.off += (size_t)txnlen;
+        if (r.u8() != kEor) {
+            fprintf(stderr, "zlogcat: %s: missing end-of-record marker\n",
+                    fname);
+            st->bad++;
+            break;
+        }
+        if (!crc_ok) {
+            fprintf(stderr, "zlogcat: %s: checksum mismatch at offset %zu\n",
+                    fname, r.off);
+            st->bad++;
+            continue;
+        }
+
+        int64_t client_id = tr.i64();
+        int32_t cxid = tr.i32();
+        int64_t zxid = tr.i64();
+        int64_t time_ms = tr.i64();
+        int32_t type = tr.i32();
+        if (!tr.ok) {
+            st->bad++;
+            continue;
+        }
+
+        /* session bookkeeping runs before filters so -S is accurate */
+        if (type == kCreateSession) {
+            Reader peek{tr.data + tr.off, tr.len - tr.off};
+            SessionInfo si;
+            si.opened_at = time_ms;
+            si.timeout = peek.i32();
+            st->open_sessions[client_id] = si;
+        }
+        int64_t duration_ms = -1;
+        if (type == kCloseSession) {
+            auto it = st->open_sessions.find(client_id);
+            if (it != st->open_sessions.end()) {
+                duration_ms = time_ms - it->second.opened_at;
+                st->open_sessions.erase(it);
+            }
+        }
+
+        if (f.time_from >= 0 && (time_ms < f.time_from ||
+                                 time_ms > f.time_to))
+            continue;
+        if (f.session >= 0 && client_id != f.session) continue;
+        if (f.server_id >= 0 && session_server_id(client_id) != f.server_id)
+            continue;
+
+        std::string line = "{";
+        char head[256];
+        snprintf(head, sizeof(head),
+                 "\"zxid\": \"0x%" PRIx64 "\", \"time\": %" PRId64
+                 ", \"session\": \"0x%" PRIx64 "\", \"cxid\": %d, "
+                 "\"type\": \"%s\"",
+                 (uint64_t)zxid, time_ms, (uint64_t)client_id, cxid,
+                 txn_type_name(type));
+        line += head;
+        if (!decode_body(&tr, type, &line, 0)) {
+            st->bad++;
+            line += ", \"decodeError\": true";
+        }
+        if (duration_ms >= 0)
+            line += ", \"sessionDurationMs\": " + std::to_string(duration_ms);
+        line += "}";
+        puts(line.c_str());
+        st->txns++;
+    }
+
+    munmap(map, (size_t)sb.st_size);
+    return true;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+    Filters f;
+    int c;
+    while ((c = getopt(argc, argv, "t:s:z:S")) != -1) {
+        switch (c) {
+        case 't': {
+            char *dash = strchr(optarg, '-');
+            if (dash == nullptr) {
+                fprintf(stderr, "zlogcat: -t wants from-to (ms)\n");
+                return 1;
+            }
+            f.time_from = strtoll(optarg, nullptr, 0);
+            f.time_to = strtoll(dash + 1, nullptr, 0);
+            break;
+        }
+        case 's':
+            f.session = strtoll(optarg, nullptr, 0);
+            break;
+        case 'z':
+            f.server_id = (int)strtol(optarg, nullptr, 0);
+            break;
+        case 'S':
+            f.dump_open = true;
+            break;
+        default:
+            fprintf(stderr, "usage: zlogcat [-t from-to] [-s session] "
+                            "[-z serverid] [-S] <txnlog>...\n");
+            return 1;
+        }
+    }
+    if (optind >= argc) {
+        fprintf(stderr, "zlogcat: no input files\n");
+        return 1;
+    }
+
+    Stats st;
+    int rc = 0;
+    for (int i = optind; i < argc; i++)
+        if (!do_file(argv[i], f, &st)) rc = 1;
+
+    if (f.dump_open) {
+        for (const auto &kv : st.open_sessions) {
+            printf("{\"openSession\": \"0x%" PRIx64 "\", \"openedAt\": "
+                   "%" PRId64 ", \"timeoutMs\": %d, \"serverId\": %d}\n",
+                   (uint64_t)kv.first, kv.second.opened_at,
+                   kv.second.timeout, session_server_id(kv.first));
+        }
+    }
+    fprintf(stderr, "zlogcat: %" PRIu64 " txns decoded, %" PRIu64 " bad\n",
+            st.txns, st.bad);
+    return rc;
+}

@@ -1,0 +1,171 @@
+"""Tests for the native instance reconciler (native/adjust/instance_adjust).
+
+Covers the reconciliation matrix of the reference's smf_adjust — which has
+zero automated tests (SURVEY §4) — against real supervised processes.
+Instances run `sleep` via the exec template; a later topology test boots
+real binders through it.
+"""
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+ADJUST = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build", "instance_adjust")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(ADJUST),
+    reason="instance_adjust not built (make -C native)")
+
+
+def run_adjust(statedir, count, base="binder", baseport=5301,
+               exec_tmpl="sleep 300", sockdir=None, extra=None):
+    cmd = [ADJUST, "-s", str(statedir), "-b", base, "-B", str(baseport),
+           "-i", str(count), "-e", exec_tmpl]
+    if sockdir:
+        cmd += ["-d", str(sockdir)]
+    cmd += extra or []
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    return proc.returncode, proc.stdout.splitlines(), proc.stderr
+
+
+def read_pid(statedir, name):
+    with open(os.path.join(statedir, f"{name}.pid")) as f:
+        return int(f.read().strip())
+
+
+def alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    try:  # zombies answer kill(0) but are dead
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0] != "Z"
+    except (OSError, IndexError):
+        return False
+
+
+def kill_all(statedir):
+    for fn in os.listdir(statedir):
+        if fn.endswith(".pid"):
+            try:
+                pid = int(open(os.path.join(statedir, fn)).read())
+                os.kill(pid, signal.SIGKILL)
+            except (ValueError, ProcessLookupError, OSError):
+                pass
+
+
+@pytest.fixture()
+def statedir(tmp_path):
+    d = str(tmp_path / "state")
+    yield d
+    kill_all(d) if os.path.isdir(d) else None
+
+
+class TestReconcile:
+    def test_scale_up_from_zero(self, statedir):
+        rc, out, err = run_adjust(statedir, 3)
+        assert rc == 0, err
+        assert sorted(l for l in out if l.startswith("create")) == [
+            "create binder-5301", "create binder-5302", "create binder-5303"]
+        for port in (5301, 5302, 5303):
+            pid = read_pid(statedir, f"binder-{port}")
+            assert alive(pid)
+
+    def test_idempotent_second_run(self, statedir):
+        run_adjust(statedir, 2)
+        rc, out, err = run_adjust(statedir, 2)
+        assert rc == 0
+        # pure no-op: nothing created/configured/started/removed
+        assert [l for l in out if not l.startswith("unchanged")] == []
+        assert len([l for l in out if l.startswith("unchanged")]) == 2
+
+    def test_no_op_preserves_processes(self, statedir):
+        run_adjust(statedir, 2)
+        pids = [read_pid(statedir, f"binder-{p}") for p in (5301, 5302)]
+        run_adjust(statedir, 2)
+        assert [read_pid(statedir, f"binder-{p}")
+                for p in (5301, 5302)] == pids
+
+    def test_scale_down_removes_surplus(self, statedir):
+        run_adjust(statedir, 3)
+        doomed = read_pid(statedir, "binder-5303")
+        rc, out, _ = run_adjust(statedir, 1)
+        assert rc == 0
+        assert "remove binder-5302" in out and "remove binder-5303" in out
+        time.sleep(0.2)
+        assert not alive(doomed)
+        assert not os.path.exists(
+            os.path.join(statedir, "binder-5303.props"))
+        # survivor untouched
+        assert alive(read_pid(statedir, "binder-5301"))
+
+    def test_config_change_restarts(self, statedir):
+        run_adjust(statedir, 1)
+        old_pid = read_pid(statedir, "binder-5301")
+        rc, out, _ = run_adjust(statedir, 1, exec_tmpl="sleep 301")
+        assert rc == 0
+        assert "configure binder-5301" in out
+        new_pid = read_pid(statedir, "binder-5301")
+        assert new_pid != old_pid and alive(new_pid)
+        time.sleep(0.2)
+        assert not alive(old_pid)
+
+    def test_dead_instance_restored(self, statedir):
+        run_adjust(statedir, 1)
+        pid = read_pid(statedir, "binder-5301")
+        os.kill(pid, signal.SIGKILL)
+        time.sleep(0.2)
+        rc, out, _ = run_adjust(statedir, 1)
+        assert rc == 0
+        assert "restore binder-5301" in out
+        assert alive(read_pid(statedir, "binder-5301"))
+
+    def test_exec_template_substitution(self, statedir, tmp_path):
+        sockdir = str(tmp_path / "socks")
+        run_adjust(statedir, 1, exec_tmpl="echo port=%P sock=%S name=%N; "
+                                          "sleep 300", sockdir=sockdir)
+        time.sleep(0.3)
+        log = open(os.path.join(statedir, "binder-5301.log")).read()
+        assert f"port=5301 sock={sockdir}/5301 name=binder-5301" in log
+
+    def test_refresh_hook_runs_on_change_only(self, statedir, tmp_path):
+        marker = str(tmp_path / "marker")
+        hook = f"touch {marker}"
+        run_adjust(statedir, 1, extra=["-r", hook])
+        assert os.path.exists(marker)
+        os.unlink(marker)
+        run_adjust(statedir, 1, extra=["-r", hook])  # no-op run
+        assert not os.path.exists(marker)
+
+    def test_dry_run_touches_nothing(self, statedir):
+        rc, out, _ = run_adjust(statedir, 2, extra=["-n"])
+        assert rc == 0
+        assert "create binder-5301" in out
+        assert not os.path.exists(
+            os.path.join(statedir, "binder-5301.props"))
+
+    def test_count_cap(self, statedir):
+        rc, _, err = run_adjust(statedir, 33)
+        assert rc == 2 and "count > 32" in err
+
+    def test_wait_online_with_socket(self, statedir, tmp_path):
+        sockdir = str(tmp_path / "socks")
+        # instance that creates its socket after a moment, like a real
+        # binder bringing up its balancer listener
+        tmpl = ("sh -c 'sleep 0.5; python3 -c \"import socket; "
+                "s=socket.socket(socket.AF_UNIX); s.bind(\\\"%S\\\"); "
+                "import time; time.sleep(300)\"'")
+        rc, out, _ = run_adjust(statedir, 1, exec_tmpl=tmpl,
+                                sockdir=sockdir, extra=["-w"])
+        assert rc == 0
+        assert os.path.exists(os.path.join(sockdir, "5301"))
+
+    def test_wait_online_fails_for_crashing_instance(self, statedir):
+        rc, out, err = run_adjust(statedir, 1, exec_tmpl="false",
+                                  extra=["-w"])
+        assert rc == 1
+        assert "did not come online" in err

@@ -272,3 +272,35 @@ class TestReviewRegressions:
         assert _host_of("[fd00::1]:53") == "fd00::1"
         assert _host_of("10.0.0.1:53") == "10.0.0.1"
         assert _host_of("10.0.0.1") == "10.0.0.1"
+
+    def test_truncated_upstream_counts_as_failure(self):
+        """A TC=1 NOERROR response must not win with an empty answer set."""
+        async def run():
+            from binder_tpu.recursion import DnsClient, UpstreamError
+            loop = asyncio.get_running_loop()
+
+            class TruncatingServer(asyncio.DatagramProtocol):
+                def connection_made(self, transport):
+                    self.transport = transport
+
+                def datagram_received(self, data, addr):
+                    q = Message.decode(data)
+                    resp = Message(id=q.id, qr=True, tc=True,
+                                   questions=list(q.questions))
+                    self.transport.sendto(resp.encode(), addr)
+
+            transport, _ = await loop.create_datagram_endpoint(
+                TruncatingServer, local_addr=("127.0.0.1", 0))
+            port = transport.get_extra_info("sockname")[1]
+            client = DnsClient(concurrency=2, timeout=1.0)
+            try:
+                await client.lookup("x.foo.com", Type.A,
+                                    [f"127.0.0.1:{port}"])
+            except UpstreamError as e:
+                return str(e)
+            finally:
+                transport.close()
+            return None
+
+        err = asyncio.run(run())
+        assert err is not None and "truncated" in err

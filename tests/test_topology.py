@@ -1,0 +1,89 @@
+"""End-to-end zone topology test: binder-topology (setup.sh analog) drives
+instance_adjust + mbalancer + real binder processes."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys_path_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOPOLOGY = os.path.join(sys_path_root, "bin", "binder-topology")
+ADJUST = os.path.join(sys_path_root, "native", "build", "instance_adjust")
+BALANCER = os.path.join(sys_path_root, "native", "build", "mbalancer")
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(ADJUST) and os.path.exists(BALANCER)),
+    reason="native binaries not built (make -C native)")
+
+
+def udp_ask(port, name, qtype, qid=1, timeout=5.0):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    s.sendto(make_query(name, qtype, qid=qid).encode(), ("127.0.0.1", port))
+    try:
+        return Message.decode(s.recv(4096))
+    finally:
+        s.close()
+
+
+@pytest.fixture()
+def zone(tmp_path):
+    config = tmp_path / "config.json"
+    fixture = tmp_path / "fixture.json"
+    fixture.write_text(json.dumps({
+        "/com/foo/web": {"type": "host", "host": {"address": "10.7.7.7"}},
+    }))
+    config.write_text(json.dumps({
+        "dnsDomain": "foo.com", "datacenterName": "dc0",
+        "host": "127.0.0.1",
+        "store": {"backend": "fake", "fixture": str(fixture)},
+    }))
+    rundir = str(tmp_path / "run")
+    yield rundir, str(config)
+    subprocess.run([TOPOLOGY, "stop", "-D", rundir], timeout=60,
+                   capture_output=True)
+
+
+def start(rundir, config, n, baseport):
+    proc = subprocess.run(
+        [TOPOLOGY, "start", "-n", str(n), "-c", config, "-D", rundir,
+         "-p", "0", "-B", str(baseport), "--bind", "127.0.0.1"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    return int(open(os.path.join(rundir, "balancer.port")).read())
+
+
+class TestZoneTopology:
+    def test_full_zone_up_scale_down(self, zone):
+        rundir, config = zone
+        port = start(rundir, config, 2, 25301)
+        time.sleep(0.8)  # balancer scan connects backends
+
+        r = udp_ask(port, "web.foo.com", Type.A)
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "10.7.7.7"
+
+        # metric-ports file published (port+1000 convention)
+        ports = open(os.path.join(rundir, "metric_ports")).read().split()
+        assert ports == ["26301", "26302"]
+
+        # status shows both instances + balancer online
+        out = subprocess.run([TOPOLOGY, "status", "-D", rundir],
+                             capture_output=True, text=True,
+                             timeout=30).stdout
+        assert out.count("online") == 3
+
+        # scale down to 1: reconciler removes the surplus instance
+        start(rundir, config, 1, 25301)
+        time.sleep(1.2)  # balancer notices the socket left
+        r = udp_ask(port, "web.foo.com", Type.A, qid=2)
+        assert r.rcode == Rcode.NOERROR
+
+        state = os.path.join(rundir, "state")
+        assert not os.path.exists(
+            os.path.join(state, "binder-25302.props"))

@@ -1,0 +1,200 @@
+"""Tests for the native txnlog decoder (native/zklog/zlogcat).
+
+The reference's zklog.c has zero tests (SURVEY §4: "C code tests: none").
+Fixture txnlogs are generated here in the public ZooKeeper jute format:
+FileHeader(magic ZKLG, v2, dbid) then [adler32][len][txn][0x42] records.
+"""
+import json
+import os
+import struct
+import subprocess
+import zlib
+
+import pytest
+
+ZLOGCAT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "build", "zlogcat")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(ZLOGCAT),
+    reason="zlogcat not built (make -C native)")
+
+
+# ---- jute serialization helpers (writer side of the fixture) ----
+
+def jstr(s):
+    b = s.encode() if isinstance(s, str) else s
+    return struct.pack(">i", len(b)) + b
+
+
+def txn_header(session, cxid, zxid, time_ms, txn_type):
+    return struct.pack(">qiqqi", session, cxid, zxid, time_ms, txn_type)
+
+
+def create_txn(path, data, ephemeral=False, parent_cversion=None):
+    body = jstr(path) + jstr(data)
+    body += struct.pack(">i", 1)                    # one ACL entry
+    body += struct.pack(">i", 31) + jstr("world") + jstr("anyone")
+    body += struct.pack(">?", ephemeral)
+    if parent_cversion is not None:
+        body += struct.pack(">i", parent_cversion)
+    return body
+
+
+def record(session, cxid, zxid, time_ms, txn_type, body, corrupt_crc=False):
+    txn = txn_header(session, cxid, zxid, time_ms, txn_type) + body
+    crc = zlib.adler32(txn)
+    if corrupt_crc:
+        crc ^= 0xFF
+    return struct.pack(">qi", crc, len(txn)) + txn + b"\x42"
+
+
+def write_log(path, records, dbid=7, magic=0x5A4B4C47, version=2,
+              padding=64):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">iiq", magic, version, dbid))
+        for r in records:
+            f.write(r)
+        f.write(b"\x00" * padding)   # preallocated tail
+
+
+def run(args):
+    proc = subprocess.run([ZLOGCAT] + args, capture_output=True, text=True,
+                          timeout=30)
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    return proc.returncode, lines, proc.stderr
+
+
+SESSION_A = 0x100000123456789   # server id 1
+SESSION_B = 0x200000123456789   # server id 2
+
+
+def standard_log(path):
+    recs = [
+        record(SESSION_A, 1, 0x100000001, 1000, -10,
+               struct.pack(">i", 30000)),                       # createSession
+        record(SESSION_A, 2, 0x100000002, 1500, 1,
+               create_txn("/com/foo/web", b'{"type":"host"}')),  # create
+        record(SESSION_A, 3, 0x100000003, 2000, 5,
+               jstr("/com/foo/web") + jstr(b'{"type":"host","v":2}')
+               + struct.pack(">i", 1)),                          # setData
+        record(SESSION_B, 1, 0x100000004, 2500, -10,
+               struct.pack(">i", 40000)),                       # createSession
+        record(SESSION_A, 4, 0x100000005, 3000, 2,
+               jstr("/com/foo/web")),                            # delete
+        record(SESSION_A, 5, 0x100000006, 9000, -11, b""),      # closeSession
+    ]
+    write_log(path, recs)
+
+
+class TestDecode:
+    def test_basic_walk(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        rc, lines, err = run([log])
+        assert rc == 0
+        assert lines[0]["dbid"] == 7
+        types = [l["type"] for l in lines[1:]]
+        assert types == ["createSession", "create", "setData",
+                         "createSession", "delete", "closeSession"]
+        assert "6 txns decoded, 0 bad" in err
+
+    def test_create_fields(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run([log])
+        create = lines[2]
+        assert create["path"] == "/com/foo/web"
+        assert create["ephemeral"] is False
+        assert create["data"].startswith('{"type":"host"}')
+
+    def test_session_duration(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run([log])
+        close = lines[-1]
+        assert close["type"] == "closeSession"
+        assert close["sessionDurationMs"] == 8000   # 9000 - 1000
+
+    def test_open_session_dump(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run(["-S", log])
+        opens = [l for l in lines if "openSession" in l]
+        assert len(opens) == 1
+        assert opens[0]["openSession"] == f"0x{SESSION_B:x}"
+        assert opens[0]["serverId"] == 2
+
+    def test_time_filter(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run(["-t", "1400-2600", log])
+        types = [l["type"] for l in lines[1:]]
+        assert types == ["create", "setData", "createSession"]
+
+    def test_session_filter(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run(["-s", str(SESSION_B), log])
+        assert [l["type"] for l in lines[1:]] == ["createSession"]
+
+    def test_server_id_filter(self, tmp_path):
+        log = str(tmp_path / "log.1")
+        standard_log(log)
+        _, lines, _ = run(["-z", "2", log])
+        assert [l["type"] for l in lines[1:]] == ["createSession"]
+
+    def test_multi_txn(self, tmp_path):
+        sub1 = txn_header(0, 0, 0, 0, 0)[:0]  # multi sub-txns have no hdr
+        inner_create = create_txn("/a", b"x", parent_cversion=1)
+        inner_delete = jstr("/b")
+        body = struct.pack(">i", 2)
+        body += struct.pack(">i", 1) + jstr(inner_create)
+        body += struct.pack(">i", 2) + jstr(inner_delete)
+        log = str(tmp_path / "log.m")
+        write_log(log, [record(SESSION_A, 1, 1, 100, 14, body)])
+        _, lines, err = run([log])
+        multi = lines[1]
+        assert multi["type"] == "multi"
+        assert [op["type"] for op in multi["ops"]] == ["create", "delete"]
+        assert multi["ops"][1]["path"] == "/b"
+
+
+class TestRobustness:
+    def test_bad_magic_rejected(self, tmp_path):
+        log = str(tmp_path / "bad")
+        write_log(log, [], magic=0x41424344)
+        rc, lines, err = run([log])
+        assert rc == 1 and "bad file header" in err
+
+    def test_corrupt_crc_counted(self, tmp_path):
+        log = str(tmp_path / "log.c")
+        write_log(log, [
+            record(SESSION_A, 1, 1, 100, 2, jstr("/a"), corrupt_crc=True),
+            record(SESSION_A, 2, 2, 200, 2, jstr("/b")),
+        ])
+        rc, lines, err = run([log])
+        # corrupt record skipped, good one still decoded
+        assert [l["type"] for l in lines[1:]] == ["delete"]
+        assert "1 bad" in err
+
+    def test_truncated_record_does_not_overread(self, tmp_path):
+        log = str(tmp_path / "log.t")
+        good = record(SESSION_A, 1, 1, 100, 2, jstr("/a"))
+        # claim a huge length with a short file
+        bogus = struct.pack(">qi", 123, 99999) + b"\x01\x02"
+        with open(log, "wb") as f:
+            f.write(struct.pack(">iiq", 0x5A4B4C47, 2, 1))
+            f.write(good)
+            f.write(bogus)
+        rc, lines, err = run([log])
+        assert [l["type"] for l in lines[1:]] == ["delete"]
+        assert "overruns" in err
+
+    def test_zero_padding_terminates(self, tmp_path):
+        log = str(tmp_path / "log.p")
+        write_log(log, [record(SESSION_A, 1, 1, 100, 2, jstr("/a"))],
+                  padding=4096)
+        rc, lines, err = run([log])
+        assert rc == 0
+        assert "1 txns decoded, 0 bad" in err
