@@ -1,0 +1,366 @@
+"""Asyncio ZooKeeper client implementing the StoreClient interface.
+
+The zkstream equivalent (reference ``lib/zk.js:33-39`` creates a zkstream
+Client with a 30s session timeout and rebuilds its cache on every
+``session`` event).  Speaks the public ZooKeeper 3.4 wire protocol
+directly (see ``jute.py``); no external ZK library exists in this image.
+
+Semantics:
+- **Session loop**: connect → handshake (resuming the previous session id
+  if any) → serve requests/watch events → on disconnect, reconnect with
+  backoff.  A handshake that establishes a *new* session (first connect,
+  or the old one expired) fires the ``session`` callbacks, which makes
+  the mirror cache re-register its whole watch tree
+  (``MirrorCache.rebuild``), exactly like the reference's full rebuild on
+  zkstream's ``session`` event (``lib/zk.js:45-47,68-76``).  We
+  conservatively fire ``session`` on *every* reconnect: ZK watches are
+  not replayed for a resumed session unless re-registered, and re-issuing
+  the read+watch pass is always safe (watch delivery is state-based here,
+  events carry no payload).
+- **Watches**: one-shot on the wire.  Attaching a listener to a Watcher
+  triggers an async fetch (getChildren2/getData with watch=1, or an
+  exists-watch for nodes that don't exist yet); each WatcherEvent
+  re-issues the fetch, re-arming the watch and emitting fresh state to
+  the cache (state, not deltas — same contract as FakeStore).
+- **Ping**: every timeout/3 to keep the session alive.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Callable, Dict, List, Optional
+
+from binder_tpu.store import jute
+from binder_tpu.store.interface import StoreClient, Watcher
+from binder_tpu.store.jute import Buf, Err, EventType, OpCode
+
+RECONNECT_DELAY = 1.0
+
+
+class _ZKWatcher(Watcher):
+    """Watcher whose listener attachment triggers a watched fetch."""
+
+    def __init__(self, client: "ZKClient", path: str) -> None:
+        super().__init__(path)
+        self._client = client
+
+    def on(self, event: str, cb: Callable) -> None:
+        super().on(event, cb)
+        self._client._schedule_sync(self.path, event)
+
+
+class ZKClient(StoreClient):
+    def __init__(self, address: str = "127.0.0.1", port: int = 2181,
+                 session_timeout_ms: int = 30000,
+                 log: Optional[logging.Logger] = None) -> None:
+        self.address = address
+        self.port = port
+        self.session_timeout_ms = session_timeout_ms
+        self.log = log or logging.getLogger("binder.zk")
+
+        self._session_cbs: List[Callable[[], None]] = []
+        self._watchers: Dict[str, _ZKWatcher] = {}
+        self._connected = False
+        self._closed = False
+
+        self._session_id = 0
+        self._passwd = b"\x00" * 16
+        self._negotiated_timeout = session_timeout_ms
+
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._xid = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._loop_task: Optional[asyncio.Task] = None
+        # paths we watch via exists() because they don't exist yet
+        self._exists_watch: set = set()
+
+        try:
+            asyncio.get_running_loop()
+            self._loop_task = asyncio.ensure_future(self._session_loop())
+        except RuntimeError:
+            pass  # caller starts us with start()
+
+    # -- StoreClient interface --
+
+    def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._session_loop())
+
+    def on_session(self, cb: Callable[[], None]) -> None:
+        self._session_cbs.append(cb)
+        if self._connected:
+            cb()
+
+    def watcher(self, path: str) -> Watcher:
+        w = self._watchers.get(path)
+        if w is None:
+            w = _ZKWatcher(self, path)
+            self._watchers[path] = w
+        return w
+
+    def is_connected(self) -> bool:
+        return self._connected
+
+    def close(self) -> None:
+        self._closed = True
+        self._connected = False
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for t in self._tasks + ([self._loop_task] if self._loop_task
+                                else []):
+            t.cancel()
+
+    # -- session loop --
+
+    async def _session_loop(self) -> None:
+        while not self._closed:
+            try:
+                await self._run_session()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("zk: session error: %s", e)
+            self._connected = False
+            if self._closed:
+                return
+            await asyncio.sleep(RECONNECT_DELAY)
+
+    async def _run_session(self) -> None:
+        reader, writer = await asyncio.open_connection(self.address,
+                                                       self.port)
+        self._writer = writer
+        try:
+            # ConnectRequest: protoVer, lastZxidSeen, timeout, sessionId,
+            # passwd (+ readOnly flag, 3.4+)
+            req = (jute.i32(0) + jute.i64(0)
+                   + jute.i32(self.session_timeout_ms)
+                   + jute.i64(self._session_id)
+                   + jute.buffer(self._passwd) + jute.boolean(False))
+            writer.write(jute.frame(req))
+            await writer.drain()
+
+            resp = Buf(await self._read_frame(reader))
+            resp.i32()  # protocol version
+            timeout = resp.i32()
+            session_id = resp.i64()
+            passwd = resp.buffer() or b"\x00" * 16
+            if timeout <= 0 or session_id == 0:
+                # session expired server-side: start a fresh one
+                self.log.warning("zk: session expired; starting new session")
+                self._session_id = 0
+                self._passwd = b"\x00" * 16
+                return
+            self._session_id = session_id
+            self._passwd = passwd
+            self._negotiated_timeout = timeout
+            self._connected = True
+            self.log.info("zk: session 0x%x established (timeout %dms)",
+                          session_id, timeout)
+
+            ping_task = asyncio.ensure_future(self._ping_loop())
+            self._tasks.append(ping_task)
+            try:
+                # fire session callbacks -> cache rebinds -> watched reads
+                for cb in list(self._session_cbs):
+                    cb()
+                await self._read_loop(reader)
+            finally:
+                ping_task.cancel()
+                self._tasks.remove(ping_task)
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(ConnectionError("zk: disconnected"))
+                self._pending.clear()
+        finally:
+            self._connected = False
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None
+
+    async def _read_frame(self, reader: asyncio.StreamReader) -> bytes:
+        hdr = await reader.readexactly(4)
+        (length,) = struct.unpack(">i", hdr)
+        if length < 0 or length > 4 * 1024 * 1024:
+            raise ConnectionError(f"zk: bad frame length {length}")
+        return await reader.readexactly(length)
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        # Dead-peer detection: our pings elicit replies every timeout/3,
+        # so a full session timeout with no frame at all means the server
+        # is gone even if TCP hasn't noticed (no FIN/RST on partition).
+        read_timeout = max(1.0, self._negotiated_timeout / 1000.0)
+        while True:
+            try:
+                frame = await asyncio.wait_for(self._read_frame(reader),
+                                               timeout=read_timeout)
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    "zk: no traffic within session timeout; "
+                    "assuming dead peer")
+            buf = Buf(frame)
+            xid = buf.i32()
+            if xid == jute.XID_WATCHER_EVENT:
+                buf.i64()  # zxid
+                buf.i32()  # err
+                etype = buf.i32()
+                buf.i32()  # keeper state
+                path = buf.string()
+                self._on_watch_event(etype, path)
+                continue
+            if xid == jute.XID_PING:
+                buf.i64()
+                buf.i32()
+                continue
+            zxid = buf.i64()
+            err = buf.i32()
+            fut = self._pending.pop(xid, None)
+            if fut is not None and not fut.done():
+                fut.set_result((err, buf))
+
+    async def _ping_loop(self) -> None:
+        interval = max(0.5, self._negotiated_timeout / 3000.0)
+        while True:
+            await asyncio.sleep(interval)
+            self._send(jute.XID_PING, OpCode.PING, b"")
+
+    # -- request plumbing --
+
+    def _send(self, xid: int, opcode: int, body: bytes) -> None:
+        if self._writer is None:
+            raise ConnectionError("zk: not connected")
+        self._writer.write(jute.frame(jute.i32(xid) + jute.i32(opcode)
+                                      + body))
+
+    async def _call(self, opcode: int, body: bytes):
+        self._xid += 1
+        xid = self._xid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[xid] = fut
+        self._send(xid, opcode, body)
+        return await fut
+
+    # -- public reads (used by the sync machinery and tests) --
+
+    async def get_children(self, path: str,
+                           watch: bool = False) -> Optional[List[str]]:
+        err, buf = await self._call(OpCode.GETCHILDREN2,
+                                    jute.string(path) + jute.boolean(watch))
+        if err == Err.NONODE:
+            return None
+        if err != Err.OK:
+            raise ConnectionError(f"zk: getChildren({path}) err {err}")
+        n = buf.i32()
+        return sorted(buf.string() for _ in range(max(0, n)))
+
+    async def get_data(self, path: str,
+                       watch: bool = False) -> Optional[bytes]:
+        err, buf = await self._call(OpCode.GETDATA,
+                                    jute.string(path) + jute.boolean(watch))
+        if err == Err.NONODE:
+            return None
+        if err != Err.OK:
+            raise ConnectionError(f"zk: getData({path}) err {err}")
+        return buf.buffer() or b""
+
+    async def exists(self, path: str, watch: bool = False) -> bool:
+        err, buf = await self._call(OpCode.EXISTS,
+                                    jute.string(path) + jute.boolean(watch))
+        return err == Err.OK
+
+    # -- writes (registrar-equivalent surface; used by tests/tools) --
+
+    async def create(self, path: str, data: bytes = b"") -> None:
+        body = (jute.string(path) + jute.buffer(data)
+                + jute.i32(1)          # one ACL
+                + jute.i32(31) + jute.string("world") + jute.string("anyone")
+                + jute.i32(0))         # flags: persistent
+        err, _ = await self._call(OpCode.CREATE, body)
+        if err not in (Err.OK, Err.NODEEXISTS):
+            raise ConnectionError(f"zk: create({path}) err {err}")
+
+    async def set_data(self, path: str, data: bytes) -> None:
+        err, _ = await self._call(OpCode.SETDATA, jute.string(path)
+                                  + jute.buffer(data) + jute.i32(-1))
+        if err != Err.OK:
+            raise ConnectionError(f"zk: setData({path}) err {err}")
+
+    async def delete(self, path: str) -> None:
+        err, _ = await self._call(OpCode.DELETE,
+                                  jute.string(path) + jute.i32(-1))
+        if err not in (Err.OK, Err.NONODE):
+            raise ConnectionError(f"zk: delete({path}) err {err}")
+
+    async def mkdirp(self, path: str, data: bytes = b"") -> None:
+        parts = [p for p in path.split("/") if p]
+        cur = ""
+        for i, p in enumerate(parts):
+            cur += "/" + p
+            await self.create(cur, data if i == len(parts) - 1 else b"")
+        if data and await self.get_data(path) != data:
+            await self.set_data(path, data)
+
+    # -- watch/sync machinery --
+
+    def _schedule_sync(self, path: str, event: str) -> None:
+        if not self._connected:
+            return  # the session callback will rebind + resync everything
+        task = asyncio.ensure_future(self._sync(path, event))
+        self._tasks.append(task)
+        task.add_done_callback(self._tasks.remove)
+
+    async def _sync(self, path: str, event: str) -> None:
+        """Fetch current state with a fresh watch and emit it."""
+        w = self._watchers.get(path)
+        if w is None or not w.has_listeners:
+            return
+        try:
+            if event == "children":
+                kids = await self.get_children(path, watch=True)
+                if kids is None:
+                    await self._arm_exists_watch(path)
+                    return
+                w.emit("children", kids)
+            elif event == "data":
+                data = await self.get_data(path, watch=True)
+                if data is None:
+                    await self._arm_exists_watch(path)
+                    return
+                w.emit("data", data)
+        except (ConnectionError, asyncio.CancelledError):
+            pass  # reconnect path will resync
+
+    async def _arm_exists_watch(self, path: str) -> None:
+        if path in self._exists_watch:
+            return
+        self._exists_watch.add(path)
+        try:
+            if await self.exists(path, watch=True):
+                # created between the NONODE and the exists call
+                self._exists_watch.discard(path)
+                self._schedule_sync(path, "children")
+                self._schedule_sync(path, "data")
+        except (ConnectionError, asyncio.CancelledError):
+            self._exists_watch.discard(path)
+
+    def _on_watch_event(self, etype: int, path: str) -> None:
+        self._exists_watch.discard(path)
+        if etype == EventType.CREATED:
+            self._schedule_sync(path, "children")
+            self._schedule_sync(path, "data")
+        elif etype == EventType.DATA_CHANGED:
+            self._schedule_sync(path, "data")
+        elif etype == EventType.CHILDREN_CHANGED:
+            self._schedule_sync(path, "children")
+        elif etype == EventType.DELETED:
+            # parent's children watch drives the unbind; re-arm creation
+            task = asyncio.ensure_future(self._arm_exists_watch(path))
+            self._tasks.append(task)
+            task.add_done_callback(self._tasks.remove)

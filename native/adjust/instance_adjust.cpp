@@ -226,6 +226,13 @@ struct Reconciler {
                 std::string name = fn.substr(0, fn.size() - suffix.size());
                 if (name.compare(0, opt.base.size() + 1, opt.base + "-") != 0)
                     continue;   /* not ours */
+                /* the suffix must be a bare port number, or another
+                 * instance set sharing a prefix (binder vs binder-blue)
+                 * would be claimed and torn down */
+                std::string tail = name.substr(opt.base.size() + 1);
+                if (tail.empty() ||
+                    tail.find_first_not_of("0123456789") != std::string::npos)
+                    continue;
                 auto it = by_name.find(name);
                 if (it == by_name.end()) {
                     Instance in;       /* unwanted: marked for removal */

@@ -167,7 +167,8 @@ void json_escape(const std::string &in, std::string *out) {
 
 struct Filters {
     int64_t time_from = -1, time_to = -1;
-    int64_t session = -1;
+    bool has_session = false;   /* -1 is a valid session id high-byte */
+    int64_t session = 0;
     int server_id = -1;      /* high byte of the session id */
     bool dump_open = false;
 };
@@ -314,13 +315,19 @@ bool decode_body(Reader *r, int32_t type, std::string *out, int depth) {
             int32_t sub_type = r->i32();
             std::string sub;
             bool isn;
-            if (!r->bytes(&sub, &isn)) return false;
+            if (!r->bytes(&sub, &isn)) {
+                *out += "]";   /* keep the JSON line well-formed */
+                return false;
+            }
             Reader sr{(const uint8_t *)sub.data(), sub.size()};
             if (i) *out += ", ";
             *out += "{\"type\": \"";
             *out += txn_type_name(sub_type);
             *out += "\"";
-            if (!decode_body(&sr, sub_type, out, depth + 1)) return false;
+            if (!decode_body(&sr, sub_type, out, depth + 1)) {
+                *out += "}]";
+                return false;
+            }
             *out += "}";
         }
         *out += "]";
@@ -441,7 +448,7 @@ bool do_file(const char *fname, const Filters &f, Stats *st) {
         if (f.time_from >= 0 && (time_ms < f.time_from ||
                                  time_ms > f.time_to))
             continue;
-        if (f.session >= 0 && client_id != f.session) continue;
+        if (f.has_session && client_id != f.session) continue;
         if (f.server_id >= 0 && session_server_id(client_id) != f.server_id)
             continue;
 
@@ -487,7 +494,8 @@ int main(int argc, char **argv) {
             break;
         }
         case 's':
-            f.session = strtoll(optarg, nullptr, 0);
+            f.has_session = true;
+            f.session = (int64_t)strtoull(optarg, nullptr, 0);
             break;
         case 'z':
             f.server_id = (int)strtol(optarg, nullptr, 0);

@@ -169,3 +169,13 @@ class TestReconcile:
                                   extra=["-w"])
         assert rc == 1
         assert "did not come online" in err
+
+    def test_prefixed_base_not_claimed(self, statedir):
+        """binder must not tear down binder-blue's instances."""
+        # create a foreign instance set sharing the prefix
+        run_adjust(statedir, 1, base="binder-blue", baseport=6301)
+        blue_pid = read_pid(statedir, "binder-blue-6301")
+        rc, out, _ = run_adjust(statedir, 1)  # base=binder
+        assert rc == 0
+        assert not any("binder-blue" in l for l in out)
+        assert alive(blue_pid)

@@ -1,0 +1,224 @@
+"""ZooKeeper wire-protocol client tests against the in-process ZK server.
+
+This is the layer the reference delegates to zkstream and only ever
+exercises against a live ZooKeeper (SURVEY §4); here the real jute
+protocol runs end-to-end in-process, including session expiry and
+reconnect behavior.
+"""
+import asyncio
+import json
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import MirrorCache
+from binder_tpu.store.zk_client import ZKClient
+from binder_tpu.store.zk_testserver import ZKTestServer
+
+DOMAIN = "foo.com"
+
+
+async def wait_for(predicate, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def boot(server):
+    """ZKClient + MirrorCache against the given test server."""
+    client = ZKClient(address="127.0.0.1", port=server.port,
+                      session_timeout_ms=2000)
+    cache = MirrorCache(client, DOMAIN)
+    client.start()
+    assert await wait_for(client.is_connected)
+    return client, cache
+
+
+def put_host(writer_client, path, addr):
+    return writer_client.mkdirp(
+        path, json.dumps({"type": "host",
+                          "host": {"address": addr}}).encode())
+
+
+class TestProtocol:
+    def test_session_and_reads(self):
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            client, cache = await boot(server)
+            # a second client acts as the registrar writing records
+            writer = ZKClient(address="127.0.0.1", port=server.port)
+            writer.start()
+            assert await wait_for(writer.is_connected)
+            await put_host(writer, "/com/foo/web", "10.1.2.3")
+            assert await writer.get_data("/com/foo/web") is not None
+            assert await writer.get_children("/com/foo") == ["web"]
+            assert await writer.exists("/com/foo/web")
+            assert not await writer.exists("/com/foo/nope")
+            client.close()
+            writer.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_watch_driven_mirror(self):
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            writer = ZKClient(address="127.0.0.1", port=server.port)
+            writer.start()
+            assert await wait_for(writer.is_connected)
+            await put_host(writer, "/com/foo/web", "10.1.2.3")
+
+            client, cache = await boot(server)
+            assert await wait_for(
+                lambda: cache.lookup("web.foo.com") is not None)
+            node = cache.lookup("web.foo.com")
+            assert node.data["host"]["address"] == "10.1.2.3"
+            assert cache.reverse_lookup("10.1.2.3") is node
+
+            # live update flows through the data watch
+            await writer.set_data("/com/foo/web", json.dumps(
+                {"type": "host", "host": {"address": "10.9.9.9"}}).encode())
+            assert await wait_for(
+                lambda: cache.reverse_lookup("10.9.9.9") is not None)
+            assert cache.reverse_lookup("10.1.2.3") is None
+
+            # node added later flows through the children watch
+            await put_host(writer, "/com/foo/web2", "10.4.4.4")
+            assert await wait_for(
+                lambda: cache.lookup("web2.foo.com") is not None)
+
+            # deletion unbinds
+            await writer.delete("/com/foo/web2")
+            assert await wait_for(
+                lambda: cache.lookup("web2.foo.com") is None)
+
+            client.close()
+            writer.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_session_expiry_rebuilds(self):
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            writer = ZKClient(address="127.0.0.1", port=server.port)
+            writer.start()
+            assert await wait_for(writer.is_connected)
+            await put_host(writer, "/com/foo/web", "10.1.2.3")
+
+            client, cache = await boot(server)
+            assert await wait_for(
+                lambda: cache.lookup("web.foo.com") is not None)
+
+            client_sid = client._session_id
+            server.expire_session(client_sid)
+            # expired session -> fresh session -> full rebuild; a record
+            # written while we were down must appear
+            await put_host(writer, "/com/foo/web3", "10.5.5.5")
+            assert await wait_for(
+                lambda: (client.is_connected()
+                         and client._session_id != client_sid), timeout=8)
+            assert await wait_for(
+                lambda: cache.lookup("web3.foo.com") is not None, timeout=8)
+
+            client.close()
+            writer.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_connection_blip_resyncs(self):
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            writer = ZKClient(address="127.0.0.1", port=server.port)
+            writer.start()
+            assert await wait_for(writer.is_connected)
+            await put_host(writer, "/com/foo/web", "10.1.2.3")
+
+            client, cache = await boot(server)
+            assert await wait_for(
+                lambda: cache.lookup("web.foo.com") is not None)
+            sid = client._session_id
+
+            server.drop_connections()
+            # the drop is noticed asynchronously: wait out the down/up cycle
+            assert await wait_for(lambda: not writer.is_connected(),
+                                  timeout=8)
+            assert await wait_for(writer.is_connected, timeout=8)
+            await put_host(writer, "/com/foo/web4", "10.6.6.6")
+            assert await wait_for(
+                lambda: cache.lookup("web4.foo.com") is not None, timeout=8)
+            # same session resumed, not a new one
+            assert client._session_id == sid
+
+            client.close()
+            writer.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+
+class TestFullStackOverZK:
+    def test_binder_serves_from_real_zk_protocol(self):
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            writer = ZKClient(address="127.0.0.1", port=server.port)
+            writer.start()
+            assert await wait_for(writer.is_connected)
+            await put_host(writer, "/com/foo/web", "10.1.2.3")
+            await writer.mkdirp("/com/foo/svc", json.dumps({
+                "type": "service",
+                "service": {"srvce": "_pg", "proto": "_tcp",
+                            "port": 5432}}).encode())
+            for i in range(2):
+                await writer.mkdirp(f"/com/foo/svc/lb{i}", json.dumps({
+                    "type": "load_balancer",
+                    "load_balancer": {"address": f"10.0.1.{i+1}"}}).encode())
+
+            client, cache = await boot(server)
+            binder = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="dc0", host="127.0.0.1",
+                                  port=0, collector=MetricsCollector())
+            await binder.start()
+            assert await wait_for(cache.is_ready)
+            assert await wait_for(
+                lambda: cache.lookup("svc.foo.com") is not None
+                and len(cache.lookup("svc.foo.com").children) == 2)
+
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+
+            class P(asyncio.DatagramProtocol):
+                def connection_made(self, t):
+                    t.sendto(make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                                        qid=1).encode())
+
+                def datagram_received(self, d, a):
+                    if not fut.done():
+                        fut.set_result(d)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                P, remote_addr=("127.0.0.1", binder.udp_port))
+            r = Message.decode(await asyncio.wait_for(fut, 5))
+            tr.close()
+
+            await binder.stop()
+            client.close()
+            writer.close()
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert sorted(a.target for a in r.answers) == \
+            ["lb0.svc.foo.com", "lb1.svc.foo.com"]

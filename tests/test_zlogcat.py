@@ -198,3 +198,25 @@ class TestRobustness:
         rc, lines, err = run([log])
         assert rc == 0
         assert "1 txns decoded, 0 bad" in err
+
+    def test_corrupt_multi_still_valid_json(self, tmp_path):
+        # second sub-op buffer truncated mid-record
+        body = struct.pack(">i", 2)
+        body += struct.pack(">i", 2) + jstr("/ok")
+        body += struct.pack(">i", 2) + struct.pack(">i", 50) + b"short"
+        log = str(tmp_path / "log.cm")
+        write_log(log, [record(SESSION_A, 1, 1, 100, 14, body)])
+        rc, lines, err = run([log])
+        # every emitted line parsed as JSON (run() would have thrown) and
+        # the broken record is flagged
+        assert any(l.get("decodeError") for l in lines)
+
+    def test_negative_session_filter(self, tmp_path):
+        neg_session = -0x00FFFFFFFFFFFF00  # sign bit set in high byte
+        log = str(tmp_path / "log.ns")
+        write_log(log, [
+            record(neg_session, 1, 1, 100, 2, jstr("/a")),
+            record(SESSION_A, 1, 2, 200, 2, jstr("/b")),
+        ])
+        _, lines, _ = run(["-s", str(neg_session), log])
+        assert len(lines) == 2 and lines[1]["path"] == "/a"
