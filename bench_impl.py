@@ -1,53 +1,54 @@
 """Full-stack DNS benchmark (invoked by bench.py).
 
 Measures the BASELINE.md proxy metric — DNS queries/sec and resolve-latency
-percentiles — end-to-end: real UDP datagrams through the transport engine,
-resolution engine, and mirror cache (the reference's hot path, SURVEY §3.2),
-using the in-memory fake store exactly where the reference would hit its
-in-memory ZK mirror.
+percentiles — against a REAL binder server process (`python -m
+binder_tpu.main`) over loopback UDP, dnsperf-style: the load generator
+keeps a window of queries in flight and only parses the response id +
+rcode, so the measurement is server capacity, not client parsing.
 
 Query mix mirrors BASELINE.json's proxy configs: single-host A lookups,
-round-robin service A lookups, SRV lookups, and PTR lookups.
+round-robin service A lookups, SRV lookups, and PTR lookups.  The server
+runs with queryLog disabled (per-query JSON logging is an ops knob;
+latency histograms still observe every query — the reference's bunyan
+per-query logging would equally dominate any single-machine benchmark).
 """
 from __future__ import annotations
 
 import asyncio
 import json
 import os
+import re
+import subprocess
+import sys
+import tempfile
 import time
 from typing import Dict, List
 
-from binder_tpu.dns import Message, Rcode, Type, make_query
-from binder_tpu.metrics.collector import MetricsCollector
-from binder_tpu.server import BinderServer
-from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.dns import Type, make_query
 
-DOMAIN = "bench.com"
-N_QUERIES = int(os.environ.get("BENCH_QUERIES", "20000"))
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "32"))
-BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_BASELINE.json")
+ROOT = os.path.dirname(os.path.abspath(__file__))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "50000"))
+CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "64"))
+BASELINE_FILE = os.path.join(ROOT, "BENCH_BASELINE.json")
 
-
-def build_fixture() -> MirrorCache:
-    store = FakeStore()
-    cache = MirrorCache(store, DOMAIN)
-    store.put_json("/com/bench/web",
-                   {"type": "host", "host": {"address": "10.1.0.1"}})
-    store.put_json("/com/bench/svc", {
+FIXTURE = {
+    "/com/bench/web": {"type": "host", "host": {"address": "10.1.0.1"}},
+    "/com/bench/svc": {
         "type": "service",
         "service": {"srvce": "_http", "proto": "_tcp", "port": 8080},
-    })
-    for i in range(8):
-        store.put_json(f"/com/bench/svc/lb{i}",
-                       {"type": "load_balancer",
-                        "load_balancer": {"address": f"10.1.1.{i + 1}"}})
-    store.start_session()
-    return cache
+    },
+    **{f"/com/bench/svc/lb{i}":
+       {"type": "load_balancer",
+        "load_balancer": {"address": f"10.1.1.{i + 1}"}}
+       for i in range(8)},
+}
 
 
 class BenchClient(asyncio.DatagramProtocol):
-    """Windowed UDP load generator: keeps CONCURRENCY queries in flight."""
+    """Windowed UDP load generator with timeout-retransmit (loopback UDP
+    still drops under bursts; a stalled window would hang the run)."""
+
+    RETRY_AFTER = 1.0
 
     def __init__(self, queries: List[bytes], done: asyncio.Future) -> None:
         self.queries = queries
@@ -55,8 +56,9 @@ class BenchClient(asyncio.DatagramProtocol):
         self.next_idx = 0
         self.received = 0
         self.latencies: List[float] = []
-        self.sent_at: Dict[int, float] = {}
+        self.outstanding: Dict[int, float] = {}   # qid -> sent-at
         self.errors = 0
+        self.retries = 0
 
     def connection_made(self, transport) -> None:
         self.transport = transport
@@ -68,17 +70,26 @@ class BenchClient(asyncio.DatagramProtocol):
         if i >= len(self.queries):
             return
         self.next_idx += 1
-        self.sent_at[i] = time.perf_counter()
+        self.outstanding[i] = time.perf_counter()
         self.transport.sendto(self.queries[i])
+
+    def retransmit_stale(self) -> None:
+        now = time.perf_counter()
+        for qid, t0 in list(self.outstanding.items()):
+            if now - t0 > self.RETRY_AFTER:
+                self.retries += 1
+                self.outstanding[qid] = float("inf")  # latency not counted
+                self.transport.sendto(self.queries[qid])
 
     def datagram_received(self, data, addr) -> None:
         now = time.perf_counter()
-        qid = int.from_bytes(data[:2], "big")
-        t0 = self.sent_at.pop(qid, None)
-        if t0 is not None:
+        qid = (data[0] << 8) | data[1]
+        t0 = self.outstanding.pop(qid, None)
+        if t0 is None:
+            return   # duplicate response to a retransmit
+        if t0 != float("inf"):
             self.latencies.append(now - t0)
-        msg = Message.decode(data)
-        if msg.rcode not in (Rcode.NOERROR,):
+        if data[3] & 0x0F:   # rcode nibble
             self.errors += 1
         self.received += 1
         if self.received >= len(self.queries):
@@ -88,19 +99,55 @@ class BenchClient(asyncio.DatagramProtocol):
             self._send_next()
 
 
-async def _bench() -> Dict[str, float]:
-    cache = build_fixture()
-    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
-                          datacenter_name="dc0", host="127.0.0.1", port=0,
-                          collector=MetricsCollector())
-    await server.start()
+def start_server(tmpdir: str) -> subprocess.Popen:
+    fixture = os.path.join(tmpdir, "fixture.json")
+    config = os.path.join(tmpdir, "config.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    with open(config, "w") as f:
+        json.dump({
+            "dnsDomain": "bench.com", "datacenterName": "dc0",
+            "host": "127.0.0.1",
+            "store": {"backend": "fake", "fixture": fixture},
+            "queryLog": False,
+        }, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
+         "-p", "0"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
 
+
+def wait_for_port(proc: subprocess.Popen) -> int:
+    import select
+    deadline = time.time() + 30
+    buf = b""
+    while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(0.0, deadline - time.time()))
+        if not ready:
+            break
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            raise RuntimeError("bench server exited during startup")
+        buf += chunk
+        m = re.search(rb"UDP DNS service started on [\d.]+:(\d+)", buf)
+        if m:
+            return int(m.group(1))
+    raise RuntimeError("bench server did not report its port within 30s")
+
+
+async def _drive(port: int) -> Dict[str, float]:
     mix = [
         ("web.bench.com", Type.A),
         ("svc.bench.com", Type.A),
         ("_http._tcp.svc.bench.com", Type.SRV),
         ("1.0.1.10.in-addr.arpa", Type.PTR),
     ]
+    # qids must be unique across the in-flight window; id space is 64k
+    assert N_QUERIES <= 65536
     queries = [make_query(*mix[i % len(mix)], qid=i % 65536).encode()
                for i in range(N_QUERIES)]
 
@@ -109,25 +156,39 @@ async def _bench() -> Dict[str, float]:
     t0 = time.perf_counter()
     transport, proto = await loop.create_datagram_endpoint(
         lambda: BenchClient(queries, done),
-        remote_addr=("127.0.0.1", server.udp_port))
-    await asyncio.wait_for(done, timeout=120)
+        remote_addr=("127.0.0.1", port))
+
+    async def watchdog():
+        while not done.done():
+            await asyncio.sleep(0.25)
+            proto.retransmit_stale()
+
+    wd = asyncio.ensure_future(watchdog())
+    await asyncio.wait_for(done, timeout=300)
     elapsed = time.perf_counter() - t0
+    wd.cancel()
     transport.close()
-    await server.stop()
 
     lats = sorted(proto.latencies)
-    qps = N_QUERIES / elapsed
     return {
-        "qps": qps,
+        "qps": N_QUERIES / elapsed,
         "elapsed_s": elapsed,
         "errors": proto.errors,
+        "retries": proto.retries,
         "p50_us": lats[len(lats) // 2] * 1e6,
         "p99_us": lats[int(len(lats) * 0.99)] * 1e6,
     }
 
 
 def run_bench() -> Dict[str, object]:
-    res = asyncio.run(_bench())
+    with tempfile.TemporaryDirectory() as tmpdir:
+        proc = start_server(tmpdir)
+        try:
+            port = wait_for_port(proc)
+            res = asyncio.run(_drive(port))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
 
     baseline = None
     if os.path.exists(BASELINE_FILE):
@@ -153,6 +214,7 @@ def run_bench() -> Dict[str, object]:
         "p50_us": round(res["p50_us"], 1),
         "p99_us": round(res["p99_us"], 1),
         "errors": res["errors"],
+        "retries": res.get("retries", 0),
         "queries": N_QUERIES,
         "concurrency": CONCURRENCY,
     }

@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from binder_tpu.dns.wire import (
     Message,
     Opcode,
+    OPTRecord,
     Rcode,
     Record,
     Type,
@@ -30,14 +31,17 @@ from binder_tpu.dns.wire import (
 class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
-                 "start", "_last_stamp", "times", "log_ctx")
+                 "start", "_last_stamp", "times", "log_ctx", "raw", "wire")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
                  protocol: str,
                  send: Callable[[bytes], None],
-                 client_transport: Optional[str] = None) -> None:
+                 client_transport: Optional[str] = None,
+                 raw: Optional[bytes] = None) -> None:
         self.request = request
+        self.raw = raw          # request wire bytes (answer-cache key)
+        self.wire: Optional[bytes] = None   # encoded response after respond()
         self.src = src
         self.protocol = protocol  # 'udp' | 'tcp' | 'balancer'
         # For balancer queries: the transport the client used to reach the
@@ -57,7 +61,6 @@ class QueryCtx:
         opt = request.edns
         if opt is not None:
             # echo EDNS back with our payload ceiling
-            from binder_tpu.dns.wire import OPTRecord
             self.response.additionals.append(
                 OPTRecord(name="", ttl=0, udp_payload_size=1232))
 
@@ -105,19 +108,38 @@ class QueryCtx:
 
     # -- completion --
 
+    @property
+    def udp_semantics(self) -> bool:
+        """True when the response travels to the client as a UDP datagram
+        (directly, or via the balancer fronting a UDP client) and so must
+        honor truncation.  The answer cache keys on this too — keep them
+        in lockstep."""
+        return (self.protocol == "udp"
+                or (self.protocol == "balancer"
+                    and self.client_transport != "tcp"))
+
     def respond(self) -> None:
         if self._responded:
             return
-        udp_semantics = (self.protocol == "udp"
-                         or (self.protocol == "balancer"
-                             and self.client_transport != "tcp"))
         # encode BEFORE marking responded: an encode failure must leave the
         # fallback SERVFAIL path able to answer
-        if udp_semantics:
+        if self.udp_semantics:
             wire = self.response.encode(max_size=self.request.max_udp_payload())
         else:
             wire = self.response.encode()
         self._responded = True
+        self.wire = wire
+        self.bytes_sent = len(wire)
+        self._send(wire)
+
+    def respond_raw(self, wire: bytes) -> None:
+        """Send a pre-encoded response (answer-cache hit), patching in
+        this request's id."""
+        if self._responded:
+            return
+        wire = self.request.id.to_bytes(2, "big") + wire[2:]
+        self._responded = True
+        self.wire = wire
         self.bytes_sent = len(wire)
         self._send(wire)
 

@@ -19,6 +19,7 @@ query id is recoverable, else are dropped.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import errno
 import ipaddress
 import logging
@@ -77,6 +78,7 @@ class DnsServer:
         # force-closed on shutdown or Server.wait_closed() blocks on
         # handlers stuck in read
         self._conns: set = set()
+        self._decode_cache: dict = {}
 
     # -- shared query dispatch --
     #
@@ -87,9 +89,10 @@ class DnsServer:
 
     def _dispatch(self, request: Message, src: Tuple[str, int],
                   protocol: str, send: Callable[[bytes], None],
-                  client_transport: Optional[str] = None) -> None:
+                  client_transport: Optional[str] = None,
+                  raw: Optional[bytes] = None) -> None:
         query = QueryCtx(request, src, protocol, send,
-                         client_transport=client_transport)
+                         client_transport=client_transport, raw=raw)
         if self.on_query is None:
             query.set_error(Rcode.NOTIMP)
             query.respond()
@@ -139,11 +142,35 @@ class DnsServer:
             except Exception:
                 self.log.exception("after hook failed")
 
+    # Decode cache: resolvers re-ask the same names constantly, and two
+    # queries for the same name/type/flags differ only in the 2-byte id.
+    # Keyed on the wire bytes minus the id; entries are treated as
+    # immutable templates (the query path never mutates the request).
+    _DECODE_CACHE_MAX = 4096
+    # legitimate queries are tiny; anything larger is not worth pinning
+    _CACHEABLE_QUERY_MAX = 320
+
+    def _decode_query(self, data: bytes) -> Message:
+        key = data[2:]
+        tmpl = self._decode_cache.get(key)
+        if tmpl is not None:
+            return dataclasses.replace(
+                tmpl, id=struct.unpack_from(">H", data, 0)[0])
+        msg = Message.decode(data)
+        if (len(data) <= self._CACHEABLE_QUERY_MAX
+                and not msg.qr and msg.opcode == 0
+                and len(msg.questions) == 1
+                and not msg.answers and not msg.authorities):
+            if len(self._decode_cache) >= self._DECODE_CACHE_MAX:
+                self._decode_cache.clear()
+            self._decode_cache[key] = msg
+        return msg
+
     def _handle_raw(self, data: bytes, src: Tuple[str, int],
                     protocol: str, send: Callable[[bytes], None],
                     client_transport: Optional[str] = None) -> None:
         try:
-            request = Message.decode(data)
+            request = self._decode_query(data)
         except WireError as e:
             self.log.debug("dropping malformed packet from %s: %s", src, e)
             if len(data) >= 2:
@@ -156,7 +183,8 @@ class DnsServer:
             return
         if request.qr:
             return  # not a query
-        self._dispatch(request, src, protocol, send, client_transport)
+        self._dispatch(request, src, protocol, send, client_transport,
+                       raw=data)
 
     # -- UDP --
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import ipaddress
+import socket as _socket
 import struct
 from typing import ClassVar, Dict, List, Optional, Tuple
 
@@ -223,14 +224,24 @@ class ARecord(Record):
     address: str = "0.0.0.0"
 
     def encode_rdata(self, buf, offsets):
-        buf += ipaddress.IPv4Address(self.address).packed
+        # inet_aton is ~5x cheaper than ipaddress on this hot path, but
+        # accepts legacy short/hex forms ("10.1", "0x7f.1") that would
+        # silently encode a different address than stored — the ntoa
+        # round-trip rejects anything but canonical dotted-quad
+        try:
+            packed = _socket.inet_aton(self.address)
+        except (OSError, TypeError):
+            raise WireError(f"bad A address {self.address!r}")
+        if _socket.inet_ntoa(packed) != self.address:
+            raise WireError(f"non-canonical A address {self.address!r}")
+        buf += packed
 
     @classmethod
     def decode_rdata(cls, data, off, rdlen, name, ttl, rclass):
         if rdlen != 4:
             raise WireError("A rdata must be 4 bytes")
         return cls(name=name, ttl=ttl, rclass=rclass,
-                   address=str(ipaddress.IPv4Address(data[off:off + 4])))
+                   address=_socket.inet_ntoa(data[off:off + 4]))
 
 
 @dataclasses.dataclass
@@ -554,6 +565,11 @@ class Message:
         for _ in range(ar):
             rec, off = _decode_record(data, off)
             msg.additionals.append(rec)
+        if off != len(data):
+            # trailing bytes beyond the counted records: no legitimate
+            # client produces these, and tolerating them lets attackers
+            # mint unique cache keys from one query
+            raise WireError(f"{len(data) - off} trailing bytes")
         return msg
 
     # -- convenience --

@@ -128,6 +128,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
         host=str(options.get("host", "0.0.0.0")),
         port=port,
         balancer_socket=str(balancer_socket) if balancer_socket else None,
+        query_log=bool(options.get("queryLog", True)),
+        cache_size=int(options.get("size", 10000)),
+        cache_expiry_ms=int(options.get("expiry", 60000)),
     )
     await server.start()
     log.info("done with binder init")

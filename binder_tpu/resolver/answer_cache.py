@@ -1,0 +1,76 @@
+"""Encoded-answer cache with store-generation invalidation.
+
+The modern incarnation of the reference's legacy cache flags (``-s size``
+default 10000, ``-a expiry`` default 60000 ms — reference
+``main.js:34-38``, ``README.md:40-44``): resolvers re-ask the same handful
+of names continuously, so the fully-encoded response bytes are cached,
+keyed on the request wire minus the 2-byte id.
+
+Correctness properties:
+- every entry records the mirror cache's generation counter; any mirrored
+  store mutation bumps it, so a hit can never serve pre-mutation data;
+- round-robin is preserved: each miss stores another shuffle variant (up
+  to ``variants_cap``), and hits cycle through the collected variants;
+- entries expire after ``expiry_ms`` regardless (defense in depth);
+- SERVFAIL and recursion-produced responses are never cached (the callers
+  decide; see ``BinderServer._on_query``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class AnswerCache:
+    __slots__ = ("size", "expiry_s", "variants_cap", "_entries",
+                 "hits", "misses")
+
+    def __init__(self, size: int = 10000, expiry_ms: int = 60000,
+                 variants_cap: int = 8) -> None:
+        self.size = size
+        self.expiry_s = expiry_ms / 1000.0
+        self.variants_cap = variants_cap
+        # key -> [gen, created, next_variant_idx, [wire, ...], complete]
+        self._entries: Dict[bytes, list] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: bytes, gen: int) -> Optional[bytes]:
+        if self.size <= 0:
+            return None
+        e = self._entries.get(key)
+        if e is None:
+            self.misses += 1
+            return None
+        if e[0] != gen or time.monotonic() - e[1] > self.expiry_s:
+            del self._entries[key]
+            self.misses += 1
+            return None
+        variants = e[3]
+        if not e[4] and len(variants) < self.variants_cap:
+            # rotatable answer set: keep resolving until we've collected
+            # enough shuffle variants for fair rotation
+            self.misses += 1
+            return None
+        idx = e[2]
+        e[2] = (idx + 1) % len(variants)
+        self.hits += 1
+        return variants[idx]
+
+    def put(self, key: bytes, gen: int, wire: bytes,
+            rotatable: bool = False) -> None:
+        if self.size <= 0:
+            return
+        e = self._entries.get(key)
+        if e is not None and e[0] == gen:
+            if len(e[3]) < self.variants_cap:
+                e[3].append(wire)
+            return
+        if len(self._entries) >= self.size:
+            # evict oldest insertion (dicts preserve insertion order)
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = [gen, time.monotonic(), 0, [wire],
+                              not rotatable]
+
+    def clear(self) -> None:
+        self._entries.clear()

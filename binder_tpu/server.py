@@ -24,6 +24,7 @@ from binder_tpu.metrics.collector import (
     DEFAULT_SIZE_BUCKETS,
     MetricsCollector,
 )
+from binder_tpu.resolver.answer_cache import AnswerCache
 from binder_tpu.resolver.engine import Resolver
 from binder_tpu.utils.jsonlog import log_event
 
@@ -50,13 +51,25 @@ class BinderServer:
                  collector: Optional[MetricsCollector] = None,
                  name: str = "binder",
                  host: str = "127.0.0.1", port: int = 53,
-                 balancer_socket: Optional[str] = None) -> None:
+                 balancer_socket: Optional[str] = None,
+                 query_log: bool = True,
+                 cache_size: int = 10000,
+                 cache_expiry_ms: int = 60000) -> None:
         self.log = log or logging.getLogger("binder.server")
         self.host = host
         self.port = port
         self.dns_domain = dns_domain
         self.balancer_socket = balancer_socket
         self.collector = collector or MetricsCollector()
+        # per-query logging can be disabled for high-qps deployments;
+        # slow queries (>1s) are logged regardless
+        self.query_log = query_log
+        # encoded-answer cache (the reference's -s/-a flags, main.js:34-38)
+        self.zk_cache = zk_cache
+        self.answer_cache = AnswerCache(size=cache_size,
+                                        expiry_ms=cache_expiry_ms)
+        self.cache_hit_counter = self.collector.counter(
+            "binder_answer_cache_hits", "encoded-answer cache hits")
 
         self.request_counter = self.collector.counter(
             METRIC_REQUEST_COUNTER, "count of Binder requests completed")
@@ -88,7 +101,28 @@ class BinderServer:
             "port": f"{query.src[1]}/{query.protocol}",
             "edns": query.request.edns is not None,
         })
-        return self.resolver.handle(query)
+        # answer-cache fast path: key = transport class + request wire
+        # minus id (UDP and TCP encode differently — truncation)
+        key = None
+        if query.raw is not None:
+            key = (b"u" if query.udp_semantics else b"t") + query.raw[2:]
+            wire = self.answer_cache.get(key, self.zk_cache.gen)
+            if wire is not None:
+                self.cache_hit_counter.increment()
+                query.response.rcode = wire[3] & 0x0F  # for metrics/logs
+                query.log_ctx["cached"] = True
+                query.respond_raw(wire)
+                return None
+
+        pending = self.resolver.handle(query)
+
+        if (pending is None and key is not None and query.responded
+                and query.wire is not None
+                and query.rcode() != Rcode.SERVFAIL):
+            self.answer_cache.put(
+                key, self.zk_cache.gen, query.wire,
+                rotatable=len(query.response.answers) > 1)
+        return pending
 
     # -- after hook: metrics + query log (lib/server.js:509-591) --
 
@@ -102,6 +136,8 @@ class BinderServer:
         self.latency_histogram.observe(lat_ms / 1000.0, labels)
         self.size_histogram.observe(query.bytes_sent, labels)
 
+        if not self.query_log and lat_ms <= SLOW_QUERY_MS:
+            return
         log_event(
             self.log, level, "DNS query",
             **query.log_ctx,

@@ -74,6 +74,7 @@ class TreeNode:
     # -- watch event handlers --
 
     def on_children_changed(self, kids: List[str]) -> None:
+        self.cache.gen += 1
         new_kids: Dict[str, TreeNode] = {}
         for kid in kids:
             existing = self.kids.pop(kid, None)
@@ -88,6 +89,7 @@ class TreeNode:
         self.kids = new_kids
 
     def on_data_changed(self, data: bytes) -> None:
+        self.cache.gen += 1
         try:
             parsed = json.loads(data.decode("utf-8")) if data else None
         except (ValueError, UnicodeDecodeError) as e:
@@ -145,6 +147,7 @@ class TreeNode:
                 kid.rebind()
 
     def unbind(self) -> None:
+        self.cache.gen += 1
         self.log.debug("unbinding node at %s", self.path)
         if self.watcher is not None:
             self.watcher.clear()
@@ -166,6 +169,9 @@ class MirrorCache:
         self.log = log or logging.getLogger("binder.cache")
         self.nodes: Dict[str, TreeNode] = {}
         self.rev_lookup: Dict[str, TreeNode] = {}
+        # generation counter: bumped on every mirrored mutation so answer
+        # caches layered above can invalidate without scanning
+        self.gen = 0
         store.on_session(self.rebuild)
 
     def is_ready(self) -> bool:

@@ -1,0 +1,140 @@
+"""Answer-cache behavior: correctness under mutation, rotation, expiry.
+
+The modern form of the reference's legacy -s/-a cache flags
+(main.js:34-38); invalidation is generation-based so a hit can never
+serve pre-mutation data.
+"""
+import asyncio
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+
+
+def build(cache_size=10000, **kw):
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "192.168.0.1"}})
+    store.put_json("/com/foo/svc", {
+        "type": "service",
+        "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432}})
+    for i in range(4):
+        store.put_json(f"/com/foo/svc/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+    store.start_session()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="dc0", host="127.0.0.1", port=0,
+                          collector=MetricsCollector(),
+                          cache_size=cache_size, **kw)
+    return store, cache, server
+
+
+async def udp_ask(port, name, qtype, qid=1):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class P(asyncio.DatagramProtocol):
+        def connection_made(self, t):
+            t.sendto(make_query(name, qtype, qid=qid).encode())
+
+        def datagram_received(self, d, a):
+            if not fut.done():
+                fut.set_result(d)
+
+    tr, _ = await loop.create_datagram_endpoint(
+        P, remote_addr=("127.0.0.1", port))
+    try:
+        return Message.decode(await asyncio.wait_for(fut, 5))
+    finally:
+        tr.close()
+
+
+class TestAnswerCache:
+    def test_hits_serve_same_answer_with_new_id(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            r1 = await udp_ask(server.udp_port, "web.foo.com", Type.A, 10)
+            r2 = await udp_ask(server.udp_port, "web.foo.com", Type.A, 20)
+            hits = server.answer_cache.hits
+            await server.stop()
+            return r1, r2, hits
+
+        r1, r2, hits = asyncio.run(run())
+        assert r1.id == 10 and r2.id == 20
+        assert r1.answers[0].address == r2.answers[0].address
+        assert hits >= 1
+
+    def test_store_mutation_invalidates(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            r1 = await udp_ask(server.udp_port, "web.foo.com", Type.A, 1)
+            await udp_ask(server.udp_port, "web.foo.com", Type.A, 2)  # hit
+            store.put_json("/com/foo/web",
+                           {"type": "host",
+                            "host": {"address": "192.168.0.99"}})
+            r3 = await udp_ask(server.udp_port, "web.foo.com", Type.A, 3)
+            await server.stop()
+            return r1, r3
+
+        r1, r3 = asyncio.run(run())
+        assert r1.answers[0].address == "192.168.0.1"
+        assert r3.answers[0].address == "192.168.0.99"
+
+    def test_rotation_preserved_for_service_answers(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            orders = []
+            for i in range(30):
+                r = await udp_ask(server.udp_port, "svc.foo.com", Type.A, i)
+                orders.append(tuple(a.address for a in r.answers))
+            hits = server.answer_cache.hits
+            await server.stop()
+            return orders, hits
+
+        orders, hits = asyncio.run(run())
+        # all answers always present...
+        assert all(sorted(o) == ["10.0.1.1", "10.0.1.2", "10.0.1.3",
+                                 "10.0.1.4"] for o in orders)
+        # ...but the order rotates across responses (round-robin), and
+        # the cache actually served most of them
+        assert len(set(orders)) > 1
+        assert hits >= 20
+
+    def test_cache_disabled_with_size_zero(self):
+        async def run():
+            store, cache, server = build(cache_size=0)
+            await server.start()
+            for i in range(5):
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, i)
+            hits = server.answer_cache.hits
+            await server.stop()
+            return hits
+
+        assert asyncio.run(run()) == 0
+
+    def test_refused_cached_but_invalidated_by_creation(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            r1 = await udp_ask(server.udp_port, "new.foo.com", Type.A, 1)
+            await udp_ask(server.udp_port, "new.foo.com", Type.A, 2)
+            store.put_json("/com/foo/new",
+                           {"type": "host", "host": {"address": "10.2.2.2"}})
+            r3 = await udp_ask(server.udp_port, "new.foo.com", Type.A, 3)
+            await server.stop()
+            return r1, r3
+
+        r1, r3 = asyncio.run(run())
+        assert r1.rcode == Rcode.REFUSED
+        assert r3.rcode == Rcode.NOERROR
+        assert r3.answers[0].address == "10.2.2.2"

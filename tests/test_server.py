@@ -330,3 +330,17 @@ class TestReviewRegressions:
         # UDP-origin (no EDNS): truncated at 512; TCP-origin: full answers
         assert udp_r.tc and not udp_r.answers
         assert not tcp_r.tc and len(tcp_r.answers) == 40
+
+    def test_short_form_store_address_servfail(self):
+        """inet_aton would map '10.1' -> 10.0.0.1; must SERVFAIL instead."""
+        async def run():
+            store, cache = fixture_store()
+            store.put_json("/com/foo/shorty",
+                           {"type": "host", "host": {"address": "10.1"}})
+            server = await start_server(cache)
+            r = await udp_ask(server.udp_port, "shorty.foo.com", Type.A)
+            await server.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.SERVFAIL and not r.answers

@@ -269,3 +269,14 @@ class TestReviewRegressions:
         out = Message.decode(msg.encode(max_size=512))
         assert out.tc and out.edns is not None
         assert out.edns.udp_payload_size == 1232
+
+    def test_trailing_garbage_rejected(self):
+        wire = make_query("a.foo.com", Type.A, qid=1).encode()
+        with pytest.raises(WireError):
+            Message.decode(wire + b"\xde\xad\xbe\xef")
+
+    def test_short_form_address_rejected(self):
+        msg = Message()
+        msg.answers.append(ARecord(name="h.foo.com", ttl=1, address="10.1"))
+        with pytest.raises(WireError):
+            msg.encode()
