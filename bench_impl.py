@@ -56,7 +56,8 @@ class BenchClient(asyncio.DatagramProtocol):
         self.next_idx = 0
         self.received = 0
         self.latencies: List[float] = []
-        self.outstanding: Dict[int, float] = {}   # qid -> sent-at
+        self.outstanding: Dict[int, float] = {}   # qid -> last-sent-at
+        self.retried: set = set()   # qids whose latency is tainted
         self.errors = 0
         self.retries = 0
 
@@ -78,7 +79,8 @@ class BenchClient(asyncio.DatagramProtocol):
         for qid, t0 in list(self.outstanding.items()):
             if now - t0 > self.RETRY_AFTER:
                 self.retries += 1
-                self.outstanding[qid] = float("inf")  # latency not counted
+                self.retried.add(qid)   # latency not counted
+                self.outstanding[qid] = now   # keep retrying until answered
                 self.transport.sendto(self.queries[qid])
 
     def datagram_received(self, data, addr) -> None:
@@ -87,7 +89,7 @@ class BenchClient(asyncio.DatagramProtocol):
         t0 = self.outstanding.pop(qid, None)
         if t0 is None:
             return   # duplicate response to a retransmit
-        if t0 != float("inf"):
+        if qid not in self.retried:
             self.latencies.append(now - t0)
         if data[3] & 0x0F:   # rcode nibble
             self.errors += 1

@@ -31,7 +31,8 @@ from binder_tpu.dns.wire import (
 class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
-                 "start", "_last_stamp", "times", "log_ctx", "raw", "wire")
+                 "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
+                 "cached_summary")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -42,6 +43,9 @@ class QueryCtx:
         self.request = request
         self.raw = raw          # request wire bytes (answer-cache key)
         self.wire: Optional[bytes] = None   # encoded response after respond()
+        # (answers, additional) log summaries on an answer-cache hit, so
+        # the query log keeps record detail for cached responses
+        self.cached_summary: Optional[Tuple[list, list]] = None
         self.src = src
         self.protocol = protocol  # 'udp' | 'tcp' | 'balancer'
         # For balancer queries: the transport the client used to reach the

@@ -4,7 +4,9 @@ The modern incarnation of the reference's legacy cache flags (``-s size``
 default 10000, ``-a expiry`` default 60000 ms — reference
 ``main.js:34-38``, ``README.md:40-44``): resolvers re-ask the same handful
 of names continuously, so the fully-encoded response bytes are cached,
-keyed on the request wire minus the 2-byte id.
+keyed on the request wire minus the 2-byte id.  Stored values are opaque
+to this class — the server stores ``(wire, answers_summary,
+additional_summary)`` tuples so cache hits keep full query-log detail.
 
 Correctness properties:
 - every entry records the mirror cache's generation counter; any mirrored
@@ -35,7 +37,7 @@ class AnswerCache:
         self.hits = 0
         self.misses = 0
 
-    def get(self, key: bytes, gen: int) -> Optional[bytes]:
+    def get(self, key: bytes, gen: int) -> Optional[object]:
         if self.size <= 0:
             return None
         e = self._entries.get(key)
@@ -57,19 +59,19 @@ class AnswerCache:
         self.hits += 1
         return variants[idx]
 
-    def put(self, key: bytes, gen: int, wire: bytes,
+    def put(self, key: bytes, gen: int, value: object,
             rotatable: bool = False) -> None:
         if self.size <= 0:
             return
         e = self._entries.get(key)
         if e is not None and e[0] == gen:
             if len(e[3]) < self.variants_cap:
-                e[3].append(wire)
+                e[3].append(value)
             return
         if len(self._entries) >= self.size:
             # evict oldest insertion (dicts preserve insertion order)
             self._entries.pop(next(iter(self._entries)))
-        self._entries[key] = [gen, time.monotonic(), 0, [wire],
+        self._entries[key] = [gen, time.monotonic(), 0, [value],
                               not rotatable]
 
     def clear(self) -> None:

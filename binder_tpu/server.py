@@ -34,6 +34,12 @@ METRIC_SIZE_HISTOGRAM = "binder_response_size_bytes"
 
 SLOW_QUERY_MS = 1000.0  # log at warn above this (lib/server.js:511-514)
 
+# Answer-cache keys are the raw request wire: bound the key size and the
+# request shape so attacker-padded (but well-formed) queries can't mint
+# unbounded unique keys that pin memory and evict real entries.  Kept in
+# lockstep with the decode cache's _CACHEABLE_QUERY_MAX in dns/server.py.
+ANSWER_CACHE_KEY_MAX = 320
+
 
 def strip_suffix(suffix: str, s: str) -> str:
     """Log redaction of the (long, constant) DNS domain
@@ -104,13 +110,18 @@ class BinderServer:
         # answer-cache fast path: key = transport class + request wire
         # minus id (UDP and TCP encode differently — truncation)
         key = None
-        if query.raw is not None:
+        if (query.raw is not None
+                and len(query.raw) <= ANSWER_CACHE_KEY_MAX
+                and not query.request.answers
+                and not query.request.authorities):
             key = (b"u" if query.udp_semantics else b"t") + query.raw[2:]
-            wire = self.answer_cache.get(key, self.zk_cache.gen)
-            if wire is not None:
+            cached = self.answer_cache.get(key, self.zk_cache.gen)
+            if cached is not None:
+                wire, ans, add = cached
                 self.cache_hit_counter.increment()
                 query.response.rcode = wire[3] & 0x0F  # for metrics/logs
                 query.log_ctx["cached"] = True
+                query.cached_summary = (ans, add)
                 query.respond_raw(wire)
                 return None
 
@@ -119,8 +130,11 @@ class BinderServer:
         if (pending is None and key is not None and query.responded
                 and query.wire is not None
                 and query.rcode() != Rcode.SERVFAIL):
+            ans = [self._summarize(r) for r in query.response.answers]
+            add = [self._summarize(r) for r in query.response.additionals
+                   if not isinstance(r, OPTRecord)]
             self.answer_cache.put(
-                key, self.zk_cache.gen, query.wire,
+                key, self.zk_cache.gen, (query.wire, ans, add),
                 rotatable=len(query.response.answers) > 1)
         return pending
 
@@ -138,14 +152,18 @@ class BinderServer:
 
         if not self.query_log and lat_ms <= SLOW_QUERY_MS:
             return
+        if query.cached_summary is not None:
+            ans, add = query.cached_summary
+        else:
+            ans = [self._summarize(r) for r in query.response.answers]
+            add = [self._summarize(r) for r in query.response.additionals
+                   if not isinstance(r, OPTRecord)]
         log_event(
             self.log, level, "DNS query",
             **query.log_ctx,
             rcode=Rcode.name(query.rcode()),
-            answers=[self._summarize(r) for r in query.response.answers],
-            additional=[self._summarize(r)
-                        for r in query.response.additionals
-                        if not isinstance(r, OPTRecord)],
+            answers=ans,
+            additional=add,
             latency=lat_ms,
             timers=query.times,
         )

@@ -138,3 +138,77 @@ class TestAnswerCache:
         assert r1.rcode == Rcode.REFUSED
         assert r3.rcode == Rcode.NOERROR
         assert r3.answers[0].address == "10.2.2.2"
+
+    def test_padded_queries_do_not_mint_cache_keys(self):
+        """Well-formed queries padded with bogus answer/authority records
+        (or simply oversized) must not be cached: each padding variation
+        would mint a unique full-wire key, pinning memory and evicting
+        real entries (TCP allows 64KB requests)."""
+        from binder_tpu.dns.wire import ARecord
+
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            loop = asyncio.get_running_loop()
+
+            padded = make_query("web.foo.com", Type.A, qid=5)
+            for i in range(30):
+                padded.answers.append(
+                    ARecord(name=f"pad{i}.foo.com", ttl=1,
+                            address=f"10.9.9.{i + 1}"))
+            wire = padded.encode()
+            assert len(wire) > 320
+
+            fut = loop.create_future()
+
+            class P(asyncio.DatagramProtocol):
+                def connection_made(self, t):
+                    t.sendto(wire)
+
+                def datagram_received(self, d, a):
+                    if not fut.done():
+                        fut.set_result(d)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                P, remote_addr=("127.0.0.1", server.udp_port))
+            try:
+                r = Message.decode(await asyncio.wait_for(fut, 5))
+            finally:
+                tr.close()
+            n_entries = len(server.answer_cache._entries)
+            await server.stop()
+            return r, n_entries
+
+        r, n_entries = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert n_entries == 0
+
+    def test_cache_hit_log_keeps_answer_summaries(self):
+        """Query-log lines for cache hits must still carry the served
+        records (stored alongside the cached wire)."""
+        import logging
+
+        async def run():
+            store, cache, server = build()
+            records = []
+
+            class Capture(logging.Handler):
+                def emit(self, rec):
+                    records.append(rec)
+
+            server.log.addHandler(Capture())
+            server.log.setLevel(logging.INFO)
+            await server.start()
+            await udp_ask(server.udp_port, "web.foo.com", Type.A, 1)
+            await udp_ask(server.udp_port, "web.foo.com", Type.A, 2)  # hit
+            hits = server.answer_cache.hits
+            await server.stop()
+            return records, hits
+
+        records, hits = asyncio.run(run())
+        assert hits >= 1
+        cached_logs = [r for r in records
+                       if getattr(r, "binder", {}).get("cached")]
+        assert cached_logs, "no cache-hit query log emitted"
+        for r in cached_logs:
+            assert r.binder.get("answers"), "cache-hit log lost its answers"
