@@ -8,3 +8,8 @@ from binder_tpu.recursion.recursion import (  # noqa: F401
     ResolverSource,
     StaticResolverSource,
 )
+from binder_tpu.recursion.ufds import (  # noqa: F401
+    LdapClient,
+    LdapError,
+    UfdsResolverSource,
+)

@@ -9,9 +9,10 @@ DNS domain — or, for PTR, to every binder we know of in parallel
 Structure preserved:
 - **Resolver discovery** refreshes every 5 minutes (``:40,150-171``) from a
   pluggable source.  The reference hardcodes UFDS/LDAP (``listResolvers``);
-  here that's the ``ResolverSource`` interface, with a config-driven
-  ``StaticResolverSource`` and the UFDS shape left to deployments with an
-  LDAP directory (SURVEY §7.1 step 6 calls for exactly this interface).
+  here that's the ``ResolverSource`` interface (SURVEY §7.1 step 6), with a
+  config-driven ``StaticResolverSource`` and the real
+  :class:`~binder_tpu.recursion.ufds.UfdsResolverSource` — a from-scratch
+  LDAPv3 client selected when the config carries ``recursion.ufds.url``.
 - **Best-effort init**: first discovery failure retries every 15 s forever
   and the service comes up anyway (``:183-196``); discovery errors after
   that are logged, never fatal (``:160-165``).
@@ -105,6 +106,11 @@ class Recursion:
         if source is None:
             if ufds is not None and "dcs" in (ufds or {}):
                 source = StaticResolverSource(ufds["dcs"])
+            elif ufds is not None and ufds.get("url"):
+                # the reference's real discovery path: UFDS over LDAP
+                # (sapi template recursion.ufds, lib/recursion.js:129-148)
+                from binder_tpu.recursion.ufds import UfdsResolverSource
+                source = UfdsResolverSource(ufds, log=self.log)
             else:
                 source = StaticResolverSource({})
         self.source = source
@@ -141,6 +147,9 @@ class Recursion:
         for t in self._bg:
             t.cancel()
         await asyncio.gather(*self._bg, return_exceptions=True)
+        closer = getattr(self.source, "close", None)
+        if closer is not None:
+            await closer()
 
     async def _init(self) -> None:
         """Best-effort client init with 15 s retry

@@ -110,10 +110,16 @@ class BinderServer:
         # answer-cache fast path: key = transport class + request wire
         # minus id (UDP and TCP encode differently — truncation)
         key = None
+        req = query.request
         if (query.raw is not None
                 and len(query.raw) <= ANSWER_CACHE_KEY_MAX
-                and not query.request.answers
-                and not query.request.authorities):
+                and len(req.questions) == 1
+                and not req.answers
+                and not req.authorities
+                # only EDNS in additionals: OPT affects truncation so it
+                # belongs in the key; anything else is key-minting padding
+                and all(isinstance(r, OPTRecord) for r in req.additionals)
+                and len(req.additionals) <= 1):
             key = (b"u" if query.udp_semantics else b"t") + query.raw[2:]
             cached = self.answer_cache.get(key, self.zk_cache.gen)
             if cached is not None:
@@ -133,6 +139,9 @@ class BinderServer:
             ans = [self._summarize(r) for r in query.response.answers]
             add = [self._summarize(r) for r in query.response.additionals
                    if not isinstance(r, OPTRecord)]
+            # reused by _on_after for this query's own log line too —
+            # summaries are built exactly once per resolve
+            query.cached_summary = (ans, add)
             self.answer_cache.put(
                 key, self.zk_cache.gen, (query.wire, ans, add),
                 rotatable=len(query.response.answers) > 1)

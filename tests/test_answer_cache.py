@@ -212,3 +212,48 @@ class TestAnswerCache:
         assert cached_logs, "no cache-hit query log emitted"
         for r in cached_logs:
             assert r.binder.get("answers"), "cache-hit log lost its answers"
+
+    def test_additional_padding_does_not_mint_cache_keys(self):
+        """Sub-320-byte queries varied only by bogus non-OPT additional
+        records must not be cached either (same eviction attack through
+        the additionals section)."""
+        from binder_tpu.dns.wire import ARecord
+
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            loop = asyncio.get_running_loop()
+
+            rcodes = []
+            for i in range(3):
+                q = make_query("web.foo.com", Type.A, qid=i)
+                q.additionals.append(
+                    ARecord(name=f"pad{i}.foo.com", ttl=1,
+                            address=f"10.8.8.{i + 1}"))
+                wire = q.encode()
+                assert len(wire) <= 320
+
+                fut = loop.create_future()
+
+                class P(asyncio.DatagramProtocol):
+                    def connection_made(self, t):
+                        t.sendto(wire)
+
+                    def datagram_received(self, d, a):
+                        if not fut.done():
+                            fut.set_result(d)
+
+                tr, _ = await loop.create_datagram_endpoint(
+                    P, remote_addr=("127.0.0.1", server.udp_port))
+                try:
+                    rcodes.append(
+                        Message.decode(await asyncio.wait_for(fut, 5)).rcode)
+                finally:
+                    tr.close()
+            n_entries = len(server.answer_cache._entries)
+            await server.stop()
+            return rcodes, n_entries
+
+        rcodes, n_entries = asyncio.run(run())
+        assert all(rc == Rcode.NOERROR for rc in rcodes)
+        assert n_entries == 0

@@ -1,0 +1,270 @@
+"""UFDS/LDAP resolver discovery: BER codec, LDAP client ↔ in-process
+server, and the full recursion bootstrap through the ZK mirror.
+
+The reference's UFDS integration (lib/recursion.js:129-148,202-249) has
+zero automated tests (SURVEY §4); this suite covers the re-derived
+protocol path end to end.
+"""
+import asyncio
+
+import pytest
+
+from binder_tpu.recursion import ber
+from binder_tpu.recursion.ldap_server import LdapTestServer
+from binder_tpu.recursion.recursion import Recursion
+from binder_tpu.recursion.ufds import (
+    LdapClient,
+    LdapError,
+    UfdsResolverSource,
+    encode_filter,
+    eval_filter,
+    parse_filter,
+    parse_ldap_url,
+)
+from binder_tpu.store import FakeStore, MirrorCache
+
+RESOLVER_ENTRIES = {
+    "uuid=r1, datacenter=east-1, region=home, o=smartdc": {
+        "objectclass": ["resolver"],
+        "datacenter": ["east-1"], "ip": ["10.99.99.38"],
+    },
+    "uuid=r2, datacenter=east-1, region=home, o=smartdc": {
+        "objectclass": ["resolver"],
+        "datacenter": ["east-1"], "ip": ["10.99.99.39"],
+    },
+    "uuid=r3, datacenter=west-1, region=home, o=smartdc": {
+        "objectclass": ["resolver"],
+        "datacenter": ["west-1"], "ip": ["10.77.77.10"],
+    },
+    "uuid=x1, datacenter=east-1, region=home, o=smartdc": {
+        "objectclass": ["vm"], "ip": ["10.99.99.99"],
+    },
+    "uuid=r9, datacenter=far-1, region=other, o=smartdc": {
+        "objectclass": ["resolver"],
+        "datacenter": ["far-1"], "ip": ["10.1.1.1"],
+    },
+}
+
+
+class TestBer:
+    def test_int_roundtrip(self):
+        for v in (0, 1, 127, 128, 255, 256, 65535, -1, -128, 2**31 - 1):
+            tag, content, off = ber.decode_tlv(ber.encode_int(v))
+            assert tag == ber.INTEGER
+            assert ber.decode_int(content) == v
+            assert off == len(ber.encode_int(v))
+
+    def test_long_form_length(self):
+        payload = b"x" * 300
+        enc = ber.encode_str(payload)
+        tag, content, _ = ber.decode_tlv(enc)
+        assert content == payload
+
+    def test_frame_length_incremental(self):
+        msg = ber.encode_seq([ber.encode_int(7), ber.encode_str("y" * 200)])
+        for cut in range(len(msg)):
+            assert ber.frame_length(msg[:cut]) == 0
+        assert ber.frame_length(msg) == len(msg)
+        assert ber.frame_length(msg + b"extra") == len(msg)
+
+    def test_truncated_tlv_raises(self):
+        with pytest.raises(ber.BerError):
+            ber.decode_tlv(b"\x04\x05ab")
+
+
+class TestFilters:
+    def test_parse_shapes(self):
+        assert parse_filter("(objectclass=resolver)") == \
+            ("eq", "objectclass", "resolver")
+        assert parse_filter("objectclass=resolver") == \
+            ("eq", "objectclass", "resolver")
+        assert parse_filter("(cn=*)") == ("present", "cn")
+        node = parse_filter("(&(a=1)(|(b=2)(!(c=3))))")
+        assert node[0] == "and" and node[1][1][0] == "or"
+
+    def test_parse_errors(self):
+        for bad in ("(a=b", "(&(a=b)", "(a)", "(a=b*c)", "(a=b))"):
+            with pytest.raises(LdapError):
+                parse_filter(bad)
+
+    def test_eval(self):
+        attrs = {"objectclass": ["resolver"], "ip": ["10.0.0.1"]}
+        assert eval_filter(parse_filter("(objectclass=Resolver)"), attrs)
+        assert eval_filter(parse_filter("(ip=*)"), attrs)
+        assert not eval_filter(parse_filter("(ip=10.0.0.2)"), attrs)
+        assert eval_filter(
+            parse_filter("(&(objectclass=resolver)(!(ip=9.9.9.9)))"), attrs)
+
+    def test_encode_decodes_on_server(self):
+        # exercised in the client/server tests below; here just check the
+        # encoder emits the right context tags
+        assert encode_filter(("present", "cn"))[0] == 0x87
+        assert encode_filter(("eq", "a", "b"))[0] == 0xA3
+        assert encode_filter(("and", []))[0] == 0xA0
+
+    def test_url_parse(self):
+        assert parse_ldap_url("ldaps://ufds.foo.com") == \
+            ("ldaps", "ufds.foo.com", None)
+        assert parse_ldap_url("ldap://10.0.0.5:1389") == \
+            ("ldap", "10.0.0.5", 1389)
+        assert parse_ldap_url("ldaps://[fd00::5]:636") == \
+            ("ldaps", "fd00::5", 636)
+        assert parse_ldap_url("ldap://[fd00::5]") == \
+            ("ldap", "fd00::5", None)
+        with pytest.raises(LdapError):
+            parse_ldap_url("ldap://[fd00::5")
+        with pytest.raises(LdapError):
+            parse_ldap_url("ldap://host:notaport")
+
+
+class TestLdapClientServer:
+    def run(self, coro):
+        return asyncio.run(coro)
+
+    def test_bind_and_search(self):
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES) as srv:
+                c = LdapClient("127.0.0.1", srv.port)
+                await c.connect()
+                await c.bind("cn=root", "secret")
+                entries = await c.search(
+                    "region=home, o=smartdc", "(objectclass=resolver)",
+                    attributes=("datacenter", "ip"))
+                await c.close()
+                return entries
+
+        entries = self.run(go())
+        assert len(entries) == 3
+        by_ip = {a["ip"][0]: a["datacenter"][0] for _, a in entries}
+        assert by_ip == {"10.99.99.38": "east-1", "10.99.99.39": "east-1",
+                         "10.77.77.10": "west-1"}
+
+    def test_bad_credentials(self):
+        async def go():
+            async with LdapTestServer() as srv:
+                c = LdapClient("127.0.0.1", srv.port)
+                await c.connect()
+                with pytest.raises(LdapError) as ei:
+                    await c.bind("cn=root", "wrong")
+                await c.close()
+                return ei.value
+
+        assert self.run(go()).result_code == 49
+
+    def test_search_requires_bind(self):
+        async def go():
+            async with LdapTestServer() as srv:
+                c = LdapClient("127.0.0.1", srv.port)
+                await c.connect()
+                with pytest.raises(LdapError):
+                    await c.search("o=smartdc", "(objectclass=*)")
+                await c.close()
+
+        self.run(go())
+
+    def test_presence_and_scope(self):
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES) as srv:
+                c = LdapClient("127.0.0.1", srv.port)
+                await c.connect()
+                await c.bind("cn=root", "secret")
+                all_sub = await c.search("o=smartdc", "(objectclass=*)")
+                base_only = await c.search(
+                    "uuid=r1, datacenter=east-1, region=home, o=smartdc",
+                    "(objectclass=*)", scope=0)
+                other_region = await c.search(
+                    "region=other, o=smartdc", "(objectclass=resolver)")
+                await c.close()
+                return all_sub, base_only, other_region
+
+        all_sub, base_only, other_region = self.run(go())
+        assert len(all_sub) == 5
+        assert len(base_only) == 1 and base_only[0][0].startswith("uuid=r1")
+        assert len(other_region) == 1
+        assert other_region[0][1]["datacenter"] == ["far-1"]
+
+
+def ufds_zk_fixture(addr):
+    """ZK mirror with a ufds 'service' node whose first child carries the
+    directory address — the shape lib/recursion.js:105-127 requires."""
+    store = FakeStore()
+    cache = MirrorCache(store, "foo.com")
+    store.put_json("/com/foo/ufds", {"type": "service",
+                                     "service": {"port": 636}})
+    store.put_json("/com/foo/ufds/inst0",
+                   {"type": "load_balancer",
+                    "load_balancer": {"address": addr}})
+    store.start_session()
+    return cache
+
+
+class TestUfdsResolverSource:
+    def test_bootstrap_via_zk_and_list(self):
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES) as srv:
+                cache = ufds_zk_fixture("127.0.0.1")
+                src = UfdsResolverSource({
+                    "url": f"ldap://ufds.foo.com:{srv.port}",
+                    "bindDN": "cn=root", "bindPassword": "secret"})
+                await src.init(cache)
+                res = await src.list_resolvers("home")
+                await src.close()
+                return res
+
+        res = asyncio.run(go())
+        assert {(r["datacenter"], r["ip"]) for r in res} == {
+            ("east-1", "10.99.99.38"), ("east-1", "10.99.99.39"),
+            ("west-1", "10.77.77.10")}
+
+    def test_init_fails_until_zk_resolves(self):
+        async def go():
+            store = FakeStore()
+            cache = MirrorCache(store, "foo.com")
+            store.start_session()   # session up, but no ufds node yet
+            src = UfdsResolverSource({"url": "ldap://ufds.foo.com",
+                                      "bindDN": "cn=root",
+                                      "bindPassword": "secret"})
+            with pytest.raises(LdapError):
+                await src.init(cache)
+
+        asyncio.run(go())
+
+    def test_reconnects_after_connection_loss(self):
+        async def go():
+            srv = LdapTestServer(entries=RESOLVER_ENTRIES)
+            await srv.start()
+            src = UfdsResolverSource({
+                "url": f"ldap://127.0.0.1:{srv.port}",
+                "bindDN": "cn=root", "bindPassword": "secret"})
+            await src.init(ufds_zk_fixture("127.0.0.1"))
+            first = await src.list_resolvers("home")
+            # sever: client's next search fails, connection is dropped
+            await src.client.close()
+            second = await src.list_resolvers("home")   # reconnects
+            binds = srv.bind_count
+            await src.close()
+            await srv.stop()
+            return first, second, binds
+
+        first, second, binds = asyncio.run(go())
+        assert len(first) == len(second) == 3
+        assert binds >= 2
+
+    def test_recursion_populates_dcs_from_ufds(self):
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES) as srv:
+                cache = ufds_zk_fixture("127.0.0.1")
+                rec = Recursion(
+                    zk_cache=cache, dns_domain="foo.com",
+                    datacenter_name="east-1", region_name="home",
+                    ufds={"url": f"ldap://ufds.foo.com:{srv.port}",
+                          "bindDN": "cn=root", "bindPassword": "secret"},
+                    nic_provider=lambda: [])
+                await rec.wait_ready()
+                dcs = dict(rec.dcs)
+                await rec.close()
+                return dcs
+
+        dcs = asyncio.run(go())
+        assert dcs == {"east-1": ["10.99.99.38", "10.99.99.39"],
+                       "west-1": ["10.77.77.10"]}
