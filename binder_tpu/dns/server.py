@@ -28,7 +28,7 @@ import struct
 from typing import Callable, List, Optional, Tuple
 
 from binder_tpu.dns.query import QueryCtx
-from binder_tpu.dns.wire import Message, Rcode, WireError
+from binder_tpu.dns.wire import Message, OPTRecord, Rcode, WireError
 
 BALANCER_VERSION = 1
 BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
@@ -160,9 +160,17 @@ class DnsServer:
         if (len(data) <= self._CACHEABLE_QUERY_MAX
                 and not msg.qr and msg.opcode == 0
                 and len(msg.questions) == 1
-                and not msg.answers and not msg.authorities):
+                and not msg.answers and not msg.authorities
+                # additionals: at most a bare OPT.  EDNS options (cookies,
+                # padding) vary per packet, so such wires never repeat —
+                # caching them only mints evict-pressure keys
+                and len(msg.additionals) <= 1
+                and all(isinstance(r, OPTRecord) and not r.has_options
+                        for r in msg.additionals)):
             if len(self._decode_cache) >= self._DECODE_CACHE_MAX:
-                self._decode_cache.clear()
+                # evict oldest insertion; wholesale clear() would flush
+                # the hot templates along with the cold ones
+                self._decode_cache.pop(next(iter(self._decode_cache)))
             self._decode_cache[key] = msg
         return msg
 

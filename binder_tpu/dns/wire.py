@@ -383,6 +383,10 @@ class OPTRecord(Record):
     ext_rcode: int = 0
     version: int = 0
     dnssec_ok: bool = False
+    # options (cookies, padding, ...) are ignored semantically but their
+    # presence matters to the decode cache: option bytes vary per packet,
+    # so such requests can never be cache templates
+    has_options: bool = False
 
     def encode(self, buf, offsets):
         buf.append(0)  # root name
@@ -402,6 +406,7 @@ class OPTRecord(Record):
             ext_rcode=(ttl >> 24) & 0xFF,
             version=(ttl >> 16) & 0xFF,
             dnssec_ok=bool(ttl & 0x8000),
+            has_options=bool(rdata),
         )
 
 

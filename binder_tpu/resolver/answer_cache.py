@@ -3,8 +3,10 @@
 The modern incarnation of the reference's legacy cache flags (``-s size``
 default 10000, ``-a expiry`` default 60000 ms — reference
 ``main.js:34-38``, ``README.md:40-44``): resolvers re-ask the same handful
-of names continuously, so the fully-encoded response bytes are cached,
-keyed on the request wire minus the 2-byte id.  Stored values are opaque
+of names continuously, so the fully-encoded response bytes are cached, keyed
+on the decoded fields the response depends on (transport semantics,
+RD, question, EDNS presence/payload — see ``BinderServer._on_query``;
+raw-wire keying would let per-packet EDNS options mint unbounded keys).  Stored values are opaque
 to this class — the server stores ``(wire, answers_summary,
 additional_summary)`` tuples so cache hits keep full query-log detail.
 
@@ -32,12 +34,12 @@ class AnswerCache:
         self.size = size
         self.expiry_s = expiry_ms / 1000.0
         self.variants_cap = variants_cap
-        # key -> [gen, created, next_variant_idx, [wire, ...], complete]
-        self._entries: Dict[bytes, list] = {}
+        # key -> [gen, created, next_variant_idx, [value, ...], complete]
+        self._entries: Dict[object, list] = {}
         self.hits = 0
         self.misses = 0
 
-    def get(self, key: bytes, gen: int) -> Optional[object]:
+    def get(self, key, gen: int) -> Optional[object]:
         if self.size <= 0:
             return None
         e = self._entries.get(key)
@@ -59,7 +61,7 @@ class AnswerCache:
         self.hits += 1
         return variants[idx]
 
-    def put(self, key: bytes, gen: int, value: object,
+    def put(self, key, gen: int, value: object,
             rotatable: bool = False) -> None:
         if self.size <= 0:
             return

@@ -27,18 +27,13 @@ from binder_tpu.metrics.collector import (
 from binder_tpu.resolver.answer_cache import AnswerCache
 from binder_tpu.resolver.engine import Resolver
 from binder_tpu.utils.jsonlog import log_event
+from binder_tpu.utils.probes import ProbeProvider
 
 METRIC_REQUEST_COUNTER = "binder_requests_completed"
 METRIC_LATENCY_HISTOGRAM = "binder_request_latency_seconds"
 METRIC_SIZE_HISTOGRAM = "binder_response_size_bytes"
 
 SLOW_QUERY_MS = 1000.0  # log at warn above this (lib/server.js:511-514)
-
-# Answer-cache keys are the raw request wire: bound the key size and the
-# request shape so attacker-padded (but well-formed) queries can't mint
-# unbounded unique keys that pin memory and evict real entries.  Kept in
-# lockstep with the decode cache's _CACHEABLE_QUERY_MAX in dns/server.py.
-ANSWER_CACHE_KEY_MAX = 320
 
 
 def strip_suffix(suffix: str, s: str) -> str:
@@ -60,7 +55,8 @@ class BinderServer:
                  balancer_socket: Optional[str] = None,
                  query_log: bool = True,
                  cache_size: int = 10000,
-                 cache_expiry_ms: int = 60000) -> None:
+                 cache_expiry_ms: int = 60000,
+                 probes: Optional[ProbeProvider] = None) -> None:
         self.log = log or logging.getLogger("binder.server")
         self.host = host
         self.port = port
@@ -86,6 +82,12 @@ class BinderServer:
             METRIC_SIZE_HISTOGRAM, "size in bytes of Binder responses",
             buckets=DEFAULT_SIZE_BUCKETS)
 
+        # USDT analog: provider 'binder', probes op-req-start/op-req-done
+        # fired with the query context (lib/server.js:24-29,472-474,516-518)
+        self.probes = probes or ProbeProvider("binder")
+        self.p_req_start = self.probes.probe("op-req-start")
+        self.p_req_done = self.probes.probe("op-req-done")
+
         self.resolver = Resolver(zk_cache, dns_domain=dns_domain,
                                  datacenter_name=datacenter_name,
                                  recursion=recursion, log=self.log)
@@ -101,26 +103,30 @@ class BinderServer:
     # for the recursion path (see DnsServer._dispatch) --
 
     def _on_query(self, query: QueryCtx):
+        self.p_req_start.fire(lambda: {
+            "id": query.request.id, "name": query.name(),
+            "type": query.qtype_name(), "client": query.src[0],
+            "protocol": query.protocol,
+        })
         query.log_ctx.update({
             "req_id": query.request.id,
             "client": query.src[0],
             "port": f"{query.src[1]}/{query.protocol}",
             "edns": query.request.edns is not None,
         })
-        # answer-cache fast path: key = transport class + request wire
-        # minus id (UDP and TCP encode differently — truncation)
+        # Answer-cache fast path.  The key is built from the decoded
+        # fields the response actually depends on — transport semantics
+        # (truncation), RD (drives the recursion-vs-REFUSED split on
+        # misses), question, EDNS presence and payload ceiling — NOT the
+        # raw wire: wire bytes vary with per-packet EDNS options (DNS
+        # cookies, padding) and ignored padding sections, which would
+        # mint one key per packet and evict the real entries.
         key = None
         req = query.request
-        if (query.raw is not None
-                and len(query.raw) <= ANSWER_CACHE_KEY_MAX
-                and len(req.questions) == 1
-                and not req.answers
-                and not req.authorities
-                # only EDNS in additionals: OPT affects truncation so it
-                # belongs in the key; anything else is key-minting padding
-                and all(isinstance(r, OPTRecord) for r in req.additionals)
-                and len(req.additionals) <= 1):
-            key = (b"u" if query.udp_semantics else b"t") + query.raw[2:]
+        if len(req.questions) == 1 and req.opcode == 0:
+            q0 = req.questions[0]
+            key = (query.udp_semantics, req.rd, q0.qtype, q0.qclass,
+                   q0.name, req.edns is not None, req.max_udp_payload())
             cached = self.answer_cache.get(key, self.zk_cache.gen)
             if cached is not None:
                 wire, ans, add = cached
@@ -152,6 +158,11 @@ class BinderServer:
     def _on_after(self, query: QueryCtx) -> None:
         query.stamp("log-after")
         lat_ms = query.latency_ms()
+        self.p_req_done.fire(lambda: {
+            "id": query.request.id, "name": query.name(),
+            "type": query.qtype_name(), "rcode": Rcode.name(query.rcode()),
+            "latency_ms": round(lat_ms, 3), "bytes": query.bytes_sent,
+        })
         level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
 
         labels = {"type": query.qtype_name()}

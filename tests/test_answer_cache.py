@@ -139,11 +139,11 @@ class TestAnswerCache:
         assert r3.rcode == Rcode.NOERROR
         assert r3.answers[0].address == "10.2.2.2"
 
-    def test_padded_queries_do_not_mint_cache_keys(self):
-        """Well-formed queries padded with bogus answer/authority records
-        (or simply oversized) must not be cached: each padding variation
-        would mint a unique full-wire key, pinning memory and evicting
-        real entries (TCP allows 64KB requests)."""
+    def test_padded_queries_collapse_to_one_cache_key(self):
+        """Queries padded with varying bogus answer records must not mint
+        one cache key per padding variation (memory pinning + eviction
+        attack); the canonical key ignores padding, so every variant maps
+        to the same entry and still gets correct answers."""
         from binder_tpu.dns.wire import ARecord
 
         async def run():
@@ -151,37 +151,42 @@ class TestAnswerCache:
             await server.start()
             loop = asyncio.get_running_loop()
 
-            padded = make_query("web.foo.com", Type.A, qid=5)
-            for i in range(30):
-                padded.answers.append(
-                    ARecord(name=f"pad{i}.foo.com", ttl=1,
-                            address=f"10.9.9.{i + 1}"))
-            wire = padded.encode()
-            assert len(wire) > 320
+            rcodes = []
+            for i in range(4):
+                padded = make_query("web.foo.com", Type.A, qid=5 + i)
+                for j in range(30):
+                    padded.answers.append(
+                        ARecord(name=f"pad{i}x{j}.foo.com", ttl=1,
+                                address=f"10.9.{i + 1}.{j + 1}"))
+                wire = padded.encode()
+                assert len(wire) > 320
 
-            fut = loop.create_future()
+                fut = loop.create_future()
 
-            class P(asyncio.DatagramProtocol):
-                def connection_made(self, t):
-                    t.sendto(wire)
+                class P(asyncio.DatagramProtocol):
+                    def connection_made(self, t):
+                        t.sendto(wire)
 
-                def datagram_received(self, d, a):
-                    if not fut.done():
-                        fut.set_result(d)
+                    def datagram_received(self, d, a):
+                        if not fut.done():
+                            fut.set_result(d)
 
-            tr, _ = await loop.create_datagram_endpoint(
-                P, remote_addr=("127.0.0.1", server.udp_port))
-            try:
-                r = Message.decode(await asyncio.wait_for(fut, 5))
-            finally:
-                tr.close()
+                tr, _ = await loop.create_datagram_endpoint(
+                    P, remote_addr=("127.0.0.1", server.udp_port))
+                try:
+                    rcodes.append(Message.decode(
+                        await asyncio.wait_for(fut, 5)).rcode)
+                finally:
+                    tr.close()
             n_entries = len(server.answer_cache._entries)
+            hits = server.answer_cache.hits
             await server.stop()
-            return r, n_entries
+            return rcodes, n_entries, hits
 
-        r, n_entries = asyncio.run(run())
-        assert r.rcode == Rcode.NOERROR
-        assert n_entries == 0
+        rcodes, n_entries, hits = asyncio.run(run())
+        assert all(rc == Rcode.NOERROR for rc in rcodes)
+        assert n_entries == 1
+        assert hits >= 1
 
     def test_cache_hit_log_keeps_answer_summaries(self):
         """Query-log lines for cache hits must still carry the served
@@ -214,9 +219,9 @@ class TestAnswerCache:
             assert r.binder.get("answers"), "cache-hit log lost its answers"
 
     def test_additional_padding_does_not_mint_cache_keys(self):
-        """Sub-320-byte queries varied only by bogus non-OPT additional
-        records must not be cached either (same eviction attack through
-        the additionals section)."""
+        """Queries varied only by bogus non-OPT additional records must
+        all map to one canonical key (same eviction attack through the
+        additionals section)."""
         from binder_tpu.dns.wire import ARecord
 
         async def run():
@@ -256,4 +261,53 @@ class TestAnswerCache:
 
         rcodes, n_entries = asyncio.run(run())
         assert all(rc == Rcode.NOERROR for rc in rcodes)
-        assert n_entries == 0
+        assert n_entries <= 1
+
+    def test_edns_cookie_variants_share_one_key_and_hit(self):
+        """Per-packet EDNS option bytes (DNS cookies, RFC 7873) must not
+        mint distinct cache keys — cookie-sending resolvers are the
+        common case and should get cache hits."""
+        import os
+        import struct
+
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            loop = asyncio.get_running_loop()
+
+            rcodes = []
+            for i in range(5):
+                base = make_query("web.foo.com", Type.A, qid=100 + i,
+                                  edns_payload=1232).encode()
+                # the bare OPT ends with rdlen=0; splice in a varying
+                # 8-byte COOKIE option (code 10)
+                assert base[-2:] == b"\x00\x00"
+                cookie = os.urandom(8)
+                wire = (base[:-2] + struct.pack(">HHH", 12, 10, 8) + cookie)
+
+                fut = loop.create_future()
+
+                class P(asyncio.DatagramProtocol):
+                    def connection_made(self, t):
+                        t.sendto(wire)
+
+                    def datagram_received(self, d, a):
+                        if not fut.done():
+                            fut.set_result(d)
+
+                tr, _ = await loop.create_datagram_endpoint(
+                    P, remote_addr=("127.0.0.1", server.udp_port))
+                try:
+                    rcodes.append(Message.decode(
+                        await asyncio.wait_for(fut, 5)).rcode)
+                finally:
+                    tr.close()
+            n_entries = len(server.answer_cache._entries)
+            hits = server.answer_cache.hits
+            await server.stop()
+            return rcodes, n_entries, hits
+
+        rcodes, n_entries, hits = asyncio.run(run())
+        assert all(rc == Rcode.NOERROR for rc in rcodes)
+        assert n_entries == 1
+        assert hits >= 4

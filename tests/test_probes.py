@@ -1,0 +1,93 @@
+"""Probe provider: the USDT analog (lib/server.js:24-29).
+
+Key property under test: lazy argument evaluation — fire() must not
+build its arguments when nothing listens (the dtrace .fire(function)
+semantics the reference's hot path depends on).
+"""
+import asyncio
+
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.utils.probes import ProbeProvider
+
+
+class TestProbeProvider:
+    def test_disabled_probe_never_evaluates_args(self):
+        p = ProbeProvider("t", backend="off")
+        probe = p.probe("x")
+        assert not probe.enabled
+        calls = []
+        probe.fire(lambda: calls.append(1))
+        assert calls == []
+
+    def test_subscriber_receives_args(self):
+        p = ProbeProvider("t", backend="off")
+        got = []
+        p.subscribe(lambda name, args: got.append((name, args)))
+        probe = p.probe("op-req-start")
+        assert probe.enabled
+        probe.fire(lambda: {"id": 7})
+        assert got == [("op-req-start", {"id": 7})]
+        p.unsubscribe(p._sinks[0])
+        assert not probe.enabled
+
+    def test_failing_argf_or_sink_is_swallowed(self):
+        p = ProbeProvider("t", backend="off")
+        got = []
+        p.subscribe(lambda name, args: 1 / 0)
+        p.subscribe(lambda name, args: got.append(args))
+        probe = p.probe("x")
+        probe.fire(lambda: 1 / 0)   # argf raises: nothing delivered
+        probe.fire(lambda: "ok")    # first sink raises: second still runs
+        assert got == ["ok"]
+
+    def test_probe_identity(self):
+        p = ProbeProvider("t", backend="off")
+        assert p.probe("a") is p.probe("a")
+
+    def test_server_fires_start_and_done(self):
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, "foo.com")
+            store.put_json("/com/foo/web",
+                           {"type": "host", "host": {"address": "10.0.0.1"}})
+            store.start_session()
+            provider = ProbeProvider("binder", backend="off")
+            events = []
+            provider.subscribe(lambda name, args: events.append((name, args)))
+            server = BinderServer(zk_cache=cache, dns_domain="foo.com",
+                                  datacenter_name="dc0", host="127.0.0.1",
+                                  port=0, collector=MetricsCollector(),
+                                  probes=provider)
+            await server.start()
+
+            from binder_tpu.dns import Type, make_query
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+
+            class P(asyncio.DatagramProtocol):
+                def connection_made(self, t):
+                    t.sendto(make_query("web.foo.com", Type.A,
+                                        qid=77).encode())
+
+                def datagram_received(self, d, a):
+                    if not fut.done():
+                        fut.set_result(d)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                P, remote_addr=("127.0.0.1", server.udp_port))
+            try:
+                await asyncio.wait_for(fut, 5)
+            finally:
+                tr.close()
+            await server.stop()
+            return events
+
+        events = asyncio.run(run())
+        names = [n for n, _ in events]
+        assert "op-req-start" in names and "op-req-done" in names
+        start = dict(events)["op-req-start"]
+        done = dict(events)["op-req-done"]
+        assert start["name"] == "web.foo.com" and start["id"] == 77
+        assert done["rcode"] == "NOERROR" and done["latency_ms"] >= 0
