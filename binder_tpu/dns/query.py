@@ -27,6 +27,8 @@ from binder_tpu.dns.wire import (
     Type,
 )
 
+_ECHO_OPT = OPTRecord(name="", ttl=0, udp_payload_size=1232)
+
 
 class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
@@ -64,9 +66,10 @@ class QueryCtx:
             rd=request.rd, ra=False, questions=list(request.questions))
         opt = request.edns
         if opt is not None:
-            # echo EDNS back with our payload ceiling
-            self.response.additionals.append(
-                OPTRecord(name="", ttl=0, udp_payload_size=1232))
+            # echo EDNS back with our payload ceiling; the OPT instance is
+            # shared across queries — nothing on the serve path mutates
+            # records, only the additionals *list* (which is per-query)
+            self.response.additionals.append(_ECHO_OPT)
 
     # -- request accessors --
 

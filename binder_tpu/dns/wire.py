@@ -581,10 +581,20 @@ class Message:
 
     @property
     def edns(self) -> Optional[OPTRecord]:
+        # memoized: the serve path asks several times per query and
+        # request additionals never change after decode (a request built
+        # by hand must not grow an OPT after first access)
+        try:
+            return self._edns_memo
+        except AttributeError:
+            pass
+        memo = None
         for rec in self.additionals:
             if isinstance(rec, OPTRecord):
-                return rec
-        return None
+                memo = rec
+                break
+        self._edns_memo = memo
+        return memo
 
     def max_udp_payload(self) -> int:
         opt = self.edns

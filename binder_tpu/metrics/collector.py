@@ -14,6 +14,7 @@ convention, reference ``main.js:144-151``).
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -38,12 +39,32 @@ def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+class CounterChild:
+    """Pre-resolved label handle — the per-query fast path skips the
+    label-dict sort entirely (prometheus-client 'child' pattern)."""
+
+    __slots__ = ("_counter", "_key")
+
+    def __init__(self, counter: "Counter", key: Tuple) -> None:
+        self._counter = counter
+        self._key = key
+
+    def inc(self, by: float = 1.0) -> None:
+        c = self._counter
+        with c._lock:
+            c._values[self._key] = c._values.get(self._key, 0.0) + by
+
+
 class Counter:
     def __init__(self, name: str, help: str) -> None:
         self.name = name
         self.help = help
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
+
+    def labelled(self, labels: Optional[Dict[str, str]] = None) \
+            -> CounterChild:
+        return CounterChild(self, _labels_key(labels))
 
     def increment(self, labels: Optional[Dict[str, str]] = None,
                   by: float = 1.0) -> None:
@@ -62,7 +83,31 @@ class Counter:
         return "\n".join(lines)
 
 
+class HistogramChild:
+    """Pre-resolved label handle.  ``observe`` touches exactly one
+    (non-cumulative) bucket cell via bisect instead of incrementing every
+    bucket ≥ value; exposition re-accumulates to the cumulative
+    prometheus form."""
+
+    __slots__ = ("_hist", "_key", "_cells")
+
+    def __init__(self, hist: "Histogram", key: Tuple) -> None:
+        self._hist = hist
+        self._key = key
+        with hist._lock:
+            self._cells = hist._counts.setdefault(
+                key, [0] * (len(hist.buckets) + 1))
+
+    def observe(self, value: float) -> None:
+        h = self._hist
+        with h._lock:
+            self._cells[bisect_left(h.buckets, value)] += 1
+            h._sums[self._key] = h._sums.get(self._key, 0.0) + value
+
+
 class Histogram:
+    # _counts stores per-bucket (NON-cumulative) cells, one extra slot
+    # for +Inf; cumulative conversion happens at scrape time
     def __init__(self, name: str, help: str,
                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS) -> None:
         self.name = name
@@ -70,40 +115,42 @@ class Histogram:
         self.buckets = tuple(sorted(buckets))
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
-        self._totals: Dict[Tuple, int] = {}
         self._lock = threading.Lock()
+
+    def labelled(self, labels: Optional[Dict[str, str]] = None) \
+            -> HistogramChild:
+        return HistogramChild(self, _labels_key(labels))
 
     def observe(self, value: float,
                 labels: Optional[Dict[str, str]] = None) -> None:
         key = _labels_key(labels)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            cells = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1))
+            cells[bisect_left(self.buckets, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
-            self._totals[key] = self._totals.get(key, 0) + 1
 
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
-        return self._totals.get(_labels_key(labels), 0)
+        return sum(self._counts.get(_labels_key(labels), ()))
 
     def expose(self, static: Tuple[Tuple[str, str], ...] = ()) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
         for key in sorted(self._counts):
-            counts = self._counts[key]
+            cells = self._counts[key]
             full = static + key
+            running = 0
             for i, b in enumerate(self.buckets):
+                running += cells[i]
                 lines.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(full, f'le=\"{b:g}\"')} {counts[i]}")
+                    f"{_fmt_labels(full, f'le=\"{b:g}\"')} {running}")
+            total = running + cells[len(self.buckets)]
             lines.append(f"{self.name}_bucket"
-                         f"{_fmt_labels(full, 'le=\"+Inf\"')} "
-                         f"{self._totals[key]}")
+                         f"{_fmt_labels(full, 'le=\"+Inf\"')} {total}")
             lines.append(f"{self.name}_sum{_fmt_labels(full)} "
-                         f"{self._sums[key]:g}")
-            lines.append(f"{self.name}_count{_fmt_labels(full)} "
-                         f"{self._totals[key]}")
+                         f"{self._sums.get(key, 0.0):g}")
+            lines.append(f"{self.name}_count{_fmt_labels(full)} {total}")
         return "\n".join(lines)
 
 

@@ -72,6 +72,7 @@ class BinderServer:
                                         expiry_ms=cache_expiry_ms)
         self.cache_hit_counter = self.collector.counter(
             "binder_answer_cache_hits", "encoded-answer cache hits")
+        self._cache_hit_child = self.cache_hit_counter.labelled()
 
         self.request_counter = self.collector.counter(
             METRIC_REQUEST_COUNTER, "count of Binder requests completed")
@@ -81,6 +82,9 @@ class BinderServer:
         self.size_histogram = self.collector.histogram(
             METRIC_SIZE_HISTOGRAM, "size in bytes of Binder responses",
             buckets=DEFAULT_SIZE_BUCKETS)
+        # per-qtype pre-resolved metric handles (label-sort once, not
+        # per query); key is the numeric qtype
+        self._metric_children: dict = {}
 
         # USDT analog: provider 'binder', probes op-req-start/op-req-done
         # fired with the query context (lib/server.js:24-29,472-474,516-518)
@@ -103,17 +107,12 @@ class BinderServer:
     # for the recursion path (see DnsServer._dispatch) --
 
     def _on_query(self, query: QueryCtx):
-        self.p_req_start.fire(lambda: {
-            "id": query.request.id, "name": query.name(),
-            "type": query.qtype_name(), "client": query.src[0],
-            "protocol": query.protocol,
-        })
-        query.log_ctx.update({
-            "req_id": query.request.id,
-            "client": query.src[0],
-            "port": f"{query.src[1]}/{query.protocol}",
-            "edns": query.request.edns is not None,
-        })
+        if self.p_req_start.enabled:   # skip closure alloc when off
+            self.p_req_start.fire(lambda: {
+                "id": query.request.id, "name": query.name(),
+                "type": query.qtype_name(), "client": query.src[0],
+                "protocol": query.protocol,
+            })
         # Answer-cache fast path.  The key is built from the decoded
         # fields the response actually depends on — transport semantics
         # (truncation), RD (drives the recursion-vs-REFUSED split on
@@ -130,7 +129,7 @@ class BinderServer:
             cached = self.answer_cache.get(key, self.zk_cache.gen)
             if cached is not None:
                 wire, ans, add = cached
-                self.cache_hit_counter.increment()
+                self._cache_hit_child.inc()
                 query.response.rcode = wire[3] & 0x0F  # for metrics/logs
                 query.log_ctx["cached"] = True
                 query.cached_summary = (ans, add)
@@ -158,17 +157,25 @@ class BinderServer:
     def _on_after(self, query: QueryCtx) -> None:
         query.stamp("log-after")
         lat_ms = query.latency_ms()
-        self.p_req_done.fire(lambda: {
-            "id": query.request.id, "name": query.name(),
-            "type": query.qtype_name(), "rcode": Rcode.name(query.rcode()),
-            "latency_ms": round(lat_ms, 3), "bytes": query.bytes_sent,
-        })
+        if self.p_req_done.enabled:
+            self.p_req_done.fire(lambda: {
+                "id": query.request.id, "name": query.name(),
+                "type": query.qtype_name(),
+                "rcode": Rcode.name(query.rcode()),
+                "latency_ms": round(lat_ms, 3), "bytes": query.bytes_sent,
+            })
         level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
 
-        labels = {"type": query.qtype_name()}
-        self.request_counter.increment(labels)
-        self.latency_histogram.observe(lat_ms / 1000.0, labels)
-        self.size_histogram.observe(query.bytes_sent, labels)
+        children = self._metric_children.get(query.qtype())
+        if children is None:
+            labels = {"type": query.qtype_name()}
+            children = (self.request_counter.labelled(labels),
+                        self.latency_histogram.labelled(labels),
+                        self.size_histogram.labelled(labels))
+            self._metric_children[query.qtype()] = children
+        children[0].inc()
+        children[1].observe(lat_ms / 1000.0)
+        children[2].observe(query.bytes_sent)
 
         if not self.query_log and lat_ms <= SLOW_QUERY_MS:
             return
@@ -180,6 +187,13 @@ class BinderServer:
                    if not isinstance(r, OPTRecord)]
         log_event(
             self.log, level, "DNS query",
+            # request envelope built here, not per-query in _on_query:
+            # most queries never log (queryLog off / fast), so the dict
+            # work happens only on the slow/logged path
+            req_id=query.request.id,
+            client=query.src[0],
+            port=f"{query.src[1]}/{query.protocol}",
+            edns=query.request.edns is not None,
             **query.log_ctx,
             rcode=Rcode.name(query.rcode()),
             answers=ans,

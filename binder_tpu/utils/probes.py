@@ -122,6 +122,9 @@ class ProbeProvider:
 
     def close(self) -> None:
         if self._marker is not None:
+            # detach the sink too, or probes stay 'enabled' and fire
+            # into the closed file on every query
+            self.unsubscribe(self._ftrace_sink)
             try:
                 self._marker.close()
             except OSError:
