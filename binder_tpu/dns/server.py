@@ -29,6 +29,11 @@ from typing import Callable, List, Optional, Tuple
 from binder_tpu.dns.query import QueryCtx
 from binder_tpu.dns.wire import Message, OPTRecord, Rcode, WireError
 
+try:  # batched recvmmsg/sendmmsg datapath (built by `make -C native`)
+    from binder_tpu import _binderfastio as _fastio
+except ImportError:  # pure-Python fallback: recvfrom/sendto per packet
+    _fastio = None
+
 BALANCER_VERSION = 1
 BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
 MAX_FRAME = 65_556
@@ -217,7 +222,10 @@ class DnsServer:
         loop = asyncio.get_running_loop()
         fam = socket.AF_INET6 if ":" in address else socket.AF_INET
         sock = socket.socket(fam, socket.SOCK_DGRAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # no SO_REUSEADDR: UDP has no TIME_WAIT to work around, and on
+        # Linux the option would let another local process bind a
+        # more-specific address on the same port and divert queries
+        # (the reason asyncio removed it for datagram endpoints)
         # absorb bursts while the event loop is busy with other work
         try:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
@@ -252,11 +260,79 @@ class DnsServer:
 
                 handle_raw(data, (addr[0], addr[1]), "udp", send)
 
+        if _fastio is not None:
+            on_readable = self._batched_udp_reader(sock)
         loop.add_reader(sock.fileno(), on_readable)
         self._udp_socks.append((loop, sock))
         actual = sock.getsockname()[1]
         self.log.info("UDP DNS service started on %s:%d", address, actual)
         return actual
+
+    def _batched_udp_reader(self, sock: socket.socket) -> Callable[[], None]:
+        """recvmmsg/sendmmsg datapath (native/fastio/fastio.c).
+
+        Up to 64 datagrams move per kernel crossing instead of one; on the
+        single-core deployment unit (the reference scales by adding
+        processes, boot/setup.sh:145-149, not threads) per-packet syscall
+        overhead is the throughput floor, and batching roughly halves it.
+        Responses produced synchronously during the drain are flushed as
+        one sendmmsg; responses that arrive later (the recursion path) fall
+        back to plain sendto."""
+        handle_raw = self._handle_raw
+        recv_batch = _fastio.recv_batch
+        send_batch = _fastio.send_batch
+        sendto = sock.sendto
+        fd = sock.fileno()
+        log = self.log
+        burst = self._UDP_BURST
+        batch_out: List[Optional[list]] = [None]  # non-None while draining
+
+        def on_readable() -> None:
+            out: list = []
+            batch_out[0] = out
+            try:
+                drained = 0
+                while drained < burst:
+                    try:
+                        msgs = recv_batch(fd, 64)
+                    except OSError as e:
+                        log.error("UDP socket error: %s", e)
+                        break
+                    if not msgs:
+                        break
+                    drained += len(msgs)
+                    for data, addr in msgs:
+                        def send(wire: bytes, _addr=addr) -> None:
+                            cur = batch_out[0]
+                            if cur is not None:
+                                cur.append((wire, _addr))
+                            else:   # late (async) response
+                                try:
+                                    sendto(wire, _addr)
+                                except OSError as e:
+                                    log.debug("UDP send to %s failed: %s",
+                                              _addr, e)
+                        handle_raw(data, addr, "udp", send)
+                    if len(msgs) < 64:
+                        break
+            finally:
+                batch_out[0] = None
+            if not out:
+                return
+            try:
+                sent = send_batch(fd, out)
+                if sent < len(out):
+                    # socket buffer full: one retry, then drop (UDP
+                    # clients retransmit; blocking here would stall the
+                    # event loop for every other client)
+                    sent += send_batch(fd, out[sent:])
+                    if sent < len(out):
+                        log.debug("dropped %d UDP responses (send buffer "
+                                  "full)", len(out) - sent)
+            except OSError as e:
+                log.debug("batched UDP send failed: %s", e)
+
+        return on_readable
 
     # -- TCP (2-byte length framing, RFC 1035 §4.2.2) --
 

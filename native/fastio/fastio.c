@@ -1,0 +1,275 @@
+/*
+ * _binderfastio — batched UDP syscalls for the DNS hot path.
+ *
+ * The reference's hot path is one recvfrom + one sendto per query inside
+ * the Node event loop (via the mname engine); per-packet syscall and
+ * event-loop costs are the floor of its throughput.  This extension
+ * lowers that floor for the rebuild: recvmmsg(2)/sendmmsg(2) move up to
+ * BATCH datagrams per kernel crossing, which matters on the single-core
+ * deployment unit (reference scales by adding processes, not threads —
+ * boot/setup.sh:145-149 — so per-process efficiency is the multiplier).
+ *
+ * API (IPv4 + IPv6 UDP sockets, non-blocking):
+ *   recv_batch(fd, max_n)  -> list[(bytes payload, (str host, int port))]
+ *                             empty list when the socket would block
+ *   send_batch(fd, msgs)   -> int processed count; msgs is a sequence of
+ *                             (bytes payload, addr) where addr is
+ *                             (host, port) or, for IPv6, optionally
+ *                             (host, port, flowinfo, scope_id).
+ *                             Per-destination errors (EHOSTUNREACH,
+ *                             EPERM, ...) skip that one datagram and
+ *                             continue — one unreachable client must not
+ *                             drop other clients' responses (same
+ *                             tolerance as the per-packet sendto path,
+ *                             reference lib/server.js:593-607).  Only
+ *                             EAGAIN stops early; caller retries or
+ *                             drops the remainder (UDP best effort).
+ *
+ * Pure CPython C API (no pybind11 in this image; see repo NOTES.md).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <string.h>
+#include <sys/socket.h>
+
+#define FASTIO_BATCH 64
+#define FASTIO_DGRAM_MAX 65535
+
+static PyObject *
+addr_to_tuple(const struct sockaddr_storage *ss)
+{
+    char host[INET6_ADDRSTRLEN];
+
+    if (ss->ss_family == AF_INET) {
+        const struct sockaddr_in *sa = (const struct sockaddr_in *)ss;
+        if (inet_ntop(AF_INET, &sa->sin_addr, host, sizeof(host)) == NULL)
+            return NULL;
+        return Py_BuildValue("(sI)", host, (unsigned)ntohs(sa->sin_port));
+    }
+    if (ss->ss_family == AF_INET6) {
+        /* Python's 4-tuple form, keeping flowinfo and the scope id —
+         * without the scope id, replies to link-local (fe80::) clients
+         * cannot be routed */
+        const struct sockaddr_in6 *sa6 = (const struct sockaddr_in6 *)ss;
+        if (inet_ntop(AF_INET6, &sa6->sin6_addr, host, sizeof(host)) == NULL)
+            return NULL;
+        return Py_BuildValue("(sIII)", host,
+                             (unsigned)ntohs(sa6->sin6_port),
+                             (unsigned)ntohl(sa6->sin6_flowinfo),
+                             (unsigned)sa6->sin6_scope_id);
+    }
+    PyErr_Format(PyExc_OSError, "unsupported address family %d",
+                 (int)ss->ss_family);
+    return NULL;
+}
+
+static int
+tuple_to_addr(PyObject *addr, struct sockaddr_storage *ss, socklen_t *len)
+{
+    const char *host;
+    unsigned port;
+    unsigned flowinfo = 0, scope_id = 0;
+
+    if (!PyTuple_Check(addr)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "address must be (host, port[, flowinfo, scope_id])");
+        return -1;
+    }
+    if (!PyArg_ParseTuple(addr, "sI|II;address must be (host, port"
+                          "[, flowinfo, scope_id])",
+                          &host, &port, &flowinfo, &scope_id))
+        return -1;
+    memset(ss, 0, sizeof(*ss));
+    if (strchr(host, ':') != NULL) {
+        struct sockaddr_in6 *sa6 = (struct sockaddr_in6 *)ss;
+        sa6->sin6_family = AF_INET6;
+        sa6->sin6_port = htons((uint16_t)port);
+        sa6->sin6_flowinfo = htonl(flowinfo);
+        sa6->sin6_scope_id = scope_id;
+        if (inet_pton(AF_INET6, host, &sa6->sin6_addr) != 1) {
+            PyErr_Format(PyExc_ValueError, "bad IPv6 address %s", host);
+            return -1;
+        }
+        *len = sizeof(*sa6);
+    } else {
+        struct sockaddr_in *sa = (struct sockaddr_in *)ss;
+        sa->sin_family = AF_INET;
+        sa->sin_port = htons((uint16_t)port);
+        if (inet_pton(AF_INET, host, &sa->sin_addr) != 1) {
+            PyErr_Format(PyExc_ValueError, "bad IPv4 address %s", host);
+            return -1;
+        }
+        *len = sizeof(*sa);
+    }
+    return 0;
+}
+
+static PyObject *
+fastio_recv_batch(PyObject *self, PyObject *args)
+{
+    int fd;
+    int max_n = FASTIO_BATCH;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "i|i", &fd, &max_n))
+        return NULL;
+    if (max_n < 1) max_n = 1;
+    if (max_n > FASTIO_BATCH) max_n = FASTIO_BATCH;
+
+    /* static payload arena reused across calls; safe because the GIL is
+     * held for the whole call (MSG_DONTWAIT never blocks, so there is
+     * nothing to gain from releasing it) */
+    static unsigned char bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
+    struct mmsghdr msgs[FASTIO_BATCH];
+    struct iovec iovs[FASTIO_BATCH];
+    struct sockaddr_storage addrs[FASTIO_BATCH];
+
+    memset(msgs, 0, sizeof(struct mmsghdr) * (size_t)max_n);
+    for (int i = 0; i < max_n; i++) {
+        iovs[i].iov_base = bufs[i];
+        iovs[i].iov_len = FASTIO_DGRAM_MAX;
+        msgs[i].msg_hdr.msg_iov = &iovs[i];
+        msgs[i].msg_hdr.msg_iovlen = 1;
+        msgs[i].msg_hdr.msg_name = &addrs[i];
+        msgs[i].msg_hdr.msg_namelen = sizeof(addrs[i]);
+    }
+
+    int n = recvmmsg(fd, msgs, (unsigned)max_n, MSG_DONTWAIT, NULL);
+
+    if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+            return PyList_New(0);
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    for (int i = 0; i < n; i++) {
+        PyObject *payload = PyBytes_FromStringAndSize(
+            (const char *)bufs[i], (Py_ssize_t)msgs[i].msg_len);
+        PyObject *addr = payload ? addr_to_tuple(&addrs[i]) : NULL;
+        if (payload == NULL || addr == NULL) {
+            Py_XDECREF(payload);
+            Py_XDECREF(addr);
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyObject *item = PyTuple_Pack(2, payload, addr);
+        Py_DECREF(payload);
+        Py_DECREF(addr);
+        if (item == NULL) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, item);
+    }
+    return out;
+}
+
+static PyObject *
+fastio_send_batch(PyObject *self, PyObject *args)
+{
+    int fd;
+    PyObject *seq;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "iO", &fd, &seq))
+        return NULL;
+    PyObject *fast = PySequence_Fast(seq, "msgs must be a sequence");
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t total = PySequence_Fast_GET_SIZE(fast);
+    Py_ssize_t done = 0;
+
+    while (done < total) {
+        struct mmsghdr msgs[FASTIO_BATCH];
+        struct iovec iovs[FASTIO_BATCH];
+        struct sockaddr_storage addrs[FASTIO_BATCH];
+        int n = 0;
+
+        memset(msgs, 0, sizeof(msgs[0]) * FASTIO_BATCH);
+        for (; n < FASTIO_BATCH && done + n < total; n++) {
+            PyObject *item = PySequence_Fast_GET_ITEM(fast, done + n);
+            PyObject *payload, *addr;
+            char *data;
+            Py_ssize_t dlen;
+
+            if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2) {
+                PyErr_SetString(PyExc_TypeError,
+                                "each msg must be (bytes, (host, port))");
+                goto fail;
+            }
+            payload = PyTuple_GET_ITEM(item, 0);
+            addr = PyTuple_GET_ITEM(item, 1);
+            if (PyBytes_AsStringAndSize(payload, &data, &dlen) < 0)
+                goto fail;
+            socklen_t alen;
+            if (tuple_to_addr(addr, &addrs[n], &alen) < 0)
+                goto fail;
+            iovs[n].iov_base = data;
+            iovs[n].iov_len = (size_t)dlen;
+            msgs[n].msg_hdr.msg_iov = &iovs[n];
+            msgs[n].msg_hdr.msg_iovlen = 1;
+            msgs[n].msg_hdr.msg_name = &addrs[n];
+            msgs[n].msg_hdr.msg_namelen = alen;
+        }
+
+        int sent;
+        Py_BEGIN_ALLOW_THREADS
+        sent = sendmmsg(fd, msgs, (unsigned)n, MSG_DONTWAIT);
+        Py_END_ALLOW_THREADS
+        if (sent < 0) {
+            if (errno == EINTR)
+                continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
+                break;  /* buffer full: caller retries/drops the rest */
+            /* Per-destination failure on the FIRST datagram of the chunk
+             * (sendmmsg reports errors only there; mid-chunk errors show
+             * up as a short count and land here on the next pass).  Skip
+             * that one datagram and carry on: one unreachable client
+             * (EHOSTUNREACH/EPERM/...) must not discard every other
+             * client's response.  This also terminates for socket-fatal
+             * errnos — each pass advances done. */
+            done += 1;
+            continue;
+        }
+        /* a short count means msgs[sent] hit an error; the next pass
+         * re-sends from there and takes the skip branch above */
+        done += sent;
+    }
+    Py_DECREF(fast);
+    return PyLong_FromSsize_t(done);
+
+fail:
+    Py_DECREF(fast);
+    return NULL;
+}
+
+static PyMethodDef fastio_methods[] = {
+    {"recv_batch", fastio_recv_batch, METH_VARARGS,
+     "recv_batch(fd, max_n=64) -> list[(bytes, (host, port))]"},
+    {"send_batch", fastio_send_batch, METH_VARARGS,
+     "send_batch(fd, msgs) -> int sent"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef fastio_module = {
+    PyModuleDef_HEAD_INIT,
+    "_binderfastio",
+    "Batched UDP recvmmsg/sendmmsg for the DNS hot path",
+    -1,
+    fastio_methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC
+PyInit__binderfastio(void)
+{
+    return PyModule_Create(&fastio_module);
+}

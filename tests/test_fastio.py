@@ -1,0 +1,111 @@
+"""Tests for the batched UDP syscall extension (native/fastio/fastio.c).
+
+The batched datapath replaces the reference's one-syscall-per-packet hot
+path (mname's UDP handling); these tests pin the extension's contract so
+the asyncio reader in binder_tpu/dns/server.py can rely on it.  The full
+server path over the batched reader is exercised by every UDP test in
+test_server.py whenever the extension is built.
+"""
+import socket
+import time
+
+import pytest
+
+fastio = pytest.importorskip(
+    "binder_tpu._binderfastio",
+    reason="fastio extension not built (make -C native)")
+
+
+def _udp_pair(host="127.0.0.1"):
+    fam = socket.AF_INET6 if ":" in host else socket.AF_INET
+    a = socket.socket(fam, socket.SOCK_DGRAM)
+    a.bind((host, 0))
+    a.setblocking(False)
+    b = socket.socket(fam, socket.SOCK_DGRAM)
+    b.bind((host, 0))
+    b.setblocking(False)
+    return a, b
+
+
+def _drain(sock, want, tries=50):
+    got = []
+    for _ in range(tries):
+        got += fastio.recv_batch(sock.fileno(), 64)
+        if len(got) >= want:
+            break
+        time.sleep(0.01)
+    return got
+
+
+def test_roundtrip_ipv4():
+    a, b = _udp_pair()
+    dst = a.getsockname()
+    msgs = [(b"payload-%d" % i, (dst[0], dst[1])) for i in range(10)]
+    assert fastio.send_batch(b.fileno(), msgs) == 10
+    got = _drain(a, 10)
+    assert [p for p, _ in got] == [p for p, _ in msgs]
+    # source addresses name b's bound port
+    assert all(addr == b.getsockname()[:2] for _, addr in got)
+    a.close(), b.close()
+
+
+def test_roundtrip_ipv6():
+    a, b = _udp_pair("::1")
+    dst = a.getsockname()
+    assert fastio.send_batch(b.fileno(), [(b"six", (dst[0], dst[1]))]) == 1
+    got = _drain(a, 1)
+    assert got[0][0] == b"six"
+    assert got[0][1][0] == "::1"
+    a.close(), b.close()
+
+
+def test_recv_empty_when_would_block():
+    a, _b = _udp_pair()
+    assert fastio.recv_batch(a.fileno(), 64) == []
+    a.close(), _b.close()
+
+
+def test_recv_respects_max_n():
+    a, b = _udp_pair()
+    dst = a.getsockname()[:2]
+    fastio.send_batch(b.fileno(), [(b"x%d" % i, dst) for i in range(8)])
+    time.sleep(0.05)
+    first = fastio.recv_batch(a.fileno(), 3)
+    assert len(first) == 3
+    rest = _drain(a, 5)
+    assert len(first) + len(rest) == 8
+    a.close(), b.close()
+
+
+def test_send_batch_over_64_chunks_internally():
+    a, b = _udp_pair()
+    dst = a.getsockname()[:2]
+    msgs = [(b"m%d" % i, dst) for i in range(150)]
+    sent = fastio.send_batch(b.fileno(), msgs)
+    assert sent == 150
+    got = _drain(a, 150)
+    assert len(got) == 150
+    a.close(), b.close()
+
+
+def test_send_batch_skips_bad_destination():
+    # one unreachable destination must not drop other clients' responses
+    # (port 0 fails at the first datagram with EINVAL, exercising the
+    # skip-and-continue branch in fastio.c)
+    a, b = _udp_pair()
+    dst = a.getsockname()[:2]
+    msgs = [(b"doomed", ("127.0.0.1", 0)), (b"fine-1", dst),
+            (b"fine-2", dst)]
+    assert fastio.send_batch(b.fileno(), msgs) == 3
+    got = _drain(a, 2)
+    assert [p for p, _ in got] == [b"fine-1", b"fine-2"]
+    a.close(), b.close()
+
+
+def test_send_batch_bad_args():
+    a, b = _udp_pair()
+    with pytest.raises(TypeError):
+        fastio.send_batch(b.fileno(), [(b"x",)])
+    with pytest.raises(ValueError):
+        fastio.send_batch(b.fileno(), [(b"x", ("not-an-ip", 1))])
+    a.close(), b.close()
