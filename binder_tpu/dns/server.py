@@ -240,28 +240,31 @@ class DnsServer:
         log = self.log
         burst = self._UDP_BURST
 
-        def on_readable() -> None:
-            for _ in range(burst):
-                try:
-                    data, addr = recvfrom(65535)
-                except (BlockingIOError, InterruptedError):
-                    return
-                except OSError as e:
-                    log.error("UDP socket error: %s", e)
-                    return
-
-                def send(wire: bytes, _addr=addr) -> None:
-                    try:
-                        sendto(wire, _addr)
-                    except OSError as e:
-                        # best-effort: full socket buffer or unreachable
-                        # client must not take down serving
-                        log.debug("UDP send to %s failed: %s", _addr, e)
-
-                handle_raw(data, (addr[0], addr[1]), "udp", send)
-
         if _fastio is not None:
             on_readable = self._batched_udp_reader(sock)
+        else:
+            def on_readable() -> None:
+                for _ in range(burst):
+                    try:
+                        data, addr = recvfrom(65535)
+                    except (BlockingIOError, InterruptedError):
+                        return
+                    except OSError as e:
+                        log.error("UDP socket error: %s", e)
+                        return
+
+                    def send(wire: bytes, _addr=addr) -> None:
+                        try:
+                            sendto(wire, _addr)
+                        except OSError as e:
+                            # best-effort: full socket buffer or
+                            # unreachable client must not take down
+                            # serving
+                            log.debug("UDP send to %s failed: %s",
+                                      _addr, e)
+
+                    handle_raw(data, (addr[0], addr[1]), "udp", send)
+
         loop.add_reader(sock.fileno(), on_readable)
         self._udp_socks.append((loop, sock))
         actual = sock.getsockname()[1]
@@ -312,25 +315,36 @@ class DnsServer:
                                 except OSError as e:
                                     log.debug("UDP send to %s failed: %s",
                                               _addr, e)
-                        handle_raw(data, addr, "udp", send)
+                        try:
+                            handle_raw(data, addr, "udp", send)
+                        except Exception:
+                            # isolate per packet, like the plain path's
+                            # one-callback-per-packet structure: a bug on
+                            # one query must not abandon the drain or the
+                            # flush of other clients' responses
+                            log.exception("unhandled error processing "
+                                          "packet from %s", addr)
                     if len(msgs) < 64:
                         break
             finally:
+                # flush in finally so responses already produced are
+                # never lost to an unexpected escape above
                 batch_out[0] = None
-            if not out:
-                return
-            try:
-                sent = send_batch(fd, out)
-                if sent < len(out):
-                    # socket buffer full: one retry, then drop (UDP
-                    # clients retransmit; blocking here would stall the
-                    # event loop for every other client)
-                    sent += send_batch(fd, out[sent:])
-                    if sent < len(out):
-                        log.debug("dropped %d UDP responses (send buffer "
-                                  "full)", len(out) - sent)
-            except OSError as e:
-                log.debug("batched UDP send failed: %s", e)
+                if out:
+                    try:
+                        sent = send_batch(fd, out)
+                        if sent < len(out):
+                            # socket buffer full: one retry, then drop
+                            # (UDP clients retransmit; blocking here
+                            # would stall the event loop for every other
+                            # client)
+                            sent += send_batch(fd, out[sent:])
+                            if sent < len(out):
+                                log.debug("dropped %d UDP responses "
+                                          "(send buffer full)",
+                                          len(out) - sent)
+                    except OSError as e:
+                        log.error("batched UDP send failed: %s", e)
 
         return on_readable
 

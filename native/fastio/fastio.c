@@ -220,28 +220,45 @@ fastio_send_batch(PyObject *self, PyObject *args)
             msgs[n].msg_hdr.msg_namelen = alen;
         }
 
-        int sent;
-        Py_BEGIN_ALLOW_THREADS
-        sent = sendmmsg(fd, msgs, (unsigned)n, MSG_DONTWAIT);
-        Py_END_ALLOW_THREADS
-        if (sent < 0) {
+        /* drain this parsed chunk without rebuilding it: `off` advances
+         * past sent and skipped datagrams so a run of failing
+         * destinations costs one syscall each, not a chunk re-parse */
+        int off = 0;
+        int blocked = 0;
+        while (off < n) {
+            int sent;
+            Py_BEGIN_ALLOW_THREADS
+            sent = sendmmsg(fd, msgs + off, (unsigned)(n - off),
+                            MSG_DONTWAIT);
+            Py_END_ALLOW_THREADS
+            if (sent >= 0) {
+                /* a short count means msgs[off+sent] hit an error; the
+                 * next pass re-sends from there and classifies it */
+                off += sent > 0 ? sent : 1;
+                continue;
+            }
             if (errno == EINTR)
                 continue;
-            if (errno == EAGAIN || errno == EWOULDBLOCK)
-                break;  /* buffer full: caller retries/drops the rest */
-            /* Per-destination failure on the FIRST datagram of the chunk
-             * (sendmmsg reports errors only there; mid-chunk errors show
-             * up as a short count and land here on the next pass).  Skip
-             * that one datagram and carry on: one unreachable client
-             * (EHOSTUNREACH/EPERM/...) must not discard every other
-             * client's response.  This also terminates for socket-fatal
-             * errnos — each pass advances done. */
-            done += 1;
-            continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                blocked = 1;  /* buffer full: caller retries/drops rest */
+                break;
+            }
+            if (errno == EBADF || errno == ENOTSOCK || errno == EFAULT ||
+                errno == ENOMEM) {
+                /* socket-fatal, not per-destination: surface it rather
+                 * than mislabel the batch as delivered */
+                Py_DECREF(fast);
+                return PyErr_SetFromErrno(PyExc_OSError);
+            }
+            /* per-destination failure on the first datagram of the
+             * remainder (EHOSTUNREACH/EPERM/EINVAL-bad-port/...): skip
+             * that one datagram and carry on — one unreachable client
+             * must not discard every other client's response */
+            off += 1;
         }
-        /* a short count means msgs[sent] hit an error; the next pass
-         * re-sends from there and takes the skip branch above */
-        done += sent;
+        done += off;
+        if (blocked)
+            break;
     }
     Py_DECREF(fast);
     return PyLong_FromSsize_t(done);
