@@ -83,6 +83,15 @@ class DnsServer:
         # handlers stuck in read
         self._conns: set = set()
         self._decode_cache: dict = {}
+        # Native fast-path cache (installed by BinderServer when the
+        # _binderfastio extension is built): answer-cache hits are served
+        # inside the C drain loop and never surface here.  `fastpath_gen`
+        # supplies the current mirror-cache generation per batch;
+        # `fastpath_gate` disables the path when every query must reach
+        # Python (per-query logging or probes active).
+        self.fastpath = None
+        self.fastpath_gen: Optional[Callable[[], int]] = None
+        self.fastpath_gate: Optional[Callable[[], bool]] = None
 
     # -- shared query dispatch --
     #
@@ -284,6 +293,7 @@ class DnsServer:
         handle_raw = self._handle_raw
         recv_batch = _fastio.recv_batch
         send_batch = _fastio.send_batch
+        fp_drain = getattr(_fastio, "fastpath_drain", None)
         sendto = sock.sendto
         fd = sock.fileno()
         log = self.log
@@ -293,17 +303,29 @@ class DnsServer:
         def on_readable() -> None:
             out: list = []
             batch_out[0] = out
+            # fast path on/off is decided once per readiness event — the
+            # gate (query-log / probe state) can flip at runtime
+            fp = self.fastpath
+            use_fp = (fp is not None and fp_drain is not None
+                      and (self.fastpath_gate is None
+                           or self.fastpath_gate()))
+            fp_gen = self.fastpath_gen
             try:
                 drained = 0
                 while drained < burst:
+                    served = 0
                     try:
-                        msgs = recv_batch(fd, 64)
+                        if use_fp:
+                            msgs, served = fp_drain(
+                                fp, fd, fp_gen() if fp_gen else 0, 64)
+                        else:
+                            msgs = recv_batch(fd, 64)
                     except OSError as e:
                         log.error("UDP socket error: %s", e)
                         break
-                    if not msgs:
+                    if not msgs and not served:
                         break
-                    drained += len(msgs)
+                    drained += len(msgs) + served
                     for data, addr in msgs:
                         def send(wire: bytes, _addr=addr) -> None:
                             cur = batch_out[0]
@@ -324,7 +346,7 @@ class DnsServer:
                             # flush of other clients' responses
                             log.exception("unhandled error processing "
                                           "packet from %s", addr)
-                    if len(msgs) < 64:
+                    if len(msgs) + served < 64:
                         break
             finally:
                 # flush in finally so responses already produced are

@@ -13,6 +13,7 @@ convention, reference ``main.js:144-151``).
 """
 from __future__ import annotations
 
+import logging
 import threading
 from bisect import bisect_left
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -104,6 +105,18 @@ class HistogramChild:
             self._cells[bisect_left(h.buckets, value)] += 1
             h._sums[self._key] = h._sums.get(self._key, 0.0) + value
 
+    def merge(self, cells: Sequence[int], sum_delta: float) -> None:
+        """Bulk-add externally accumulated (non-cumulative) bucket cells —
+        how natively counted observations (the fast-path drain) fold in at
+        scrape time.  ``cells`` must match this histogram's layout:
+        len(buckets)+1 with the +Inf cell last."""
+        h = self._hist
+        with h._lock:
+            for i, delta in enumerate(cells):
+                if delta:
+                    self._cells[i] += delta
+            h._sums[self._key] = h._sums.get(self._key, 0.0) + sum_delta
+
 
 class Histogram:
     # _counts stores per-bucket (NON-cumulative) cells, one extra slot
@@ -161,6 +174,12 @@ class MetricsCollector:
                  static_labels: Optional[Dict[str, str]] = None) -> None:
         self._collectors: Dict[str, object] = {}
         self.static_labels = static_labels or {}
+        self._expose_hooks: List = []
+
+    def on_expose(self, fn) -> None:
+        """Register a pre-scrape hook (e.g. folding natively accumulated
+        fast-path counts into the collectors)."""
+        self._expose_hooks.append(fn)
 
     def counter(self, name: str, help: str = "") -> Counter:
         c = self._collectors.get(name)
@@ -182,6 +201,14 @@ class MetricsCollector:
         return self._collectors.get(name)
 
     def expose(self) -> str:
+        for fn in self._expose_hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scrape must not 500 on a
+                # fold-in bug, but a silently-failing hook means natively
+                # counted queries vanish from dashboards: log it
+                logging.getLogger("binder.metrics").exception(
+                    "pre-scrape hook %r failed", fn)
         static = _labels_key({k: str(v) for k, v in
                               self.static_labels.items() if v is not None})
         return "\n".join(c.expose(static)

@@ -62,19 +62,32 @@ class AnswerCache:
         return variants[idx]
 
     def put(self, key, gen: int, value: object,
-            rotatable: bool = False) -> None:
+            rotatable: bool = False) -> bool:
+        """Record a freshly resolved value.  Returns True exactly when the
+        entry just became *complete* (non-rotatable, or the full variant
+        set collected) — the signal the server uses to push the entry to
+        the native fast path (see BinderServer._on_query)."""
         if self.size <= 0:
-            return
+            return False
         e = self._entries.get(key)
         if e is not None and e[0] == gen:
             if len(e[3]) < self.variants_cap:
                 e[3].append(value)
-            return
+                return not e[4] and len(e[3]) == self.variants_cap
+            return False
         if len(self._entries) >= self.size:
             # evict oldest insertion (dicts preserve insertion order)
             self._entries.pop(next(iter(self._entries)))
         self._entries[key] = [gen, time.monotonic(), 0, [value],
                               not rotatable]
+        return not rotatable
+
+    def variants(self, key, gen: int) -> Optional[List[object]]:
+        """All collected variants for a live entry (fast-path push)."""
+        e = self._entries.get(key)
+        if e is None or e[0] != gen:
+            return None
+        return list(e[3])
 
     def clear(self) -> None:
         self._entries.clear()

@@ -9,7 +9,13 @@ socket (``lib/server.js:609-653``).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
+
+try:  # native fast path (built by `make -C native`); optional
+    from binder_tpu import _binderfastio as _fastio
+except ImportError:
+    _fastio = None
 
 from binder_tpu.dns.query import QueryCtx
 from binder_tpu.dns.server import DnsServer
@@ -34,6 +40,12 @@ METRIC_LATENCY_HISTOGRAM = "binder_request_latency_seconds"
 METRIC_SIZE_HISTOGRAM = "binder_response_size_bytes"
 
 SLOW_QUERY_MS = 1000.0  # log at warn above this (lib/server.js:511-514)
+
+# byte values a name label may contain for the native fast path; names
+# outside this set are still served, just never through the C cache
+# (keep in lockstep with fp_name_ok in native/fastio/fastpath.c)
+_FP_NAME_OK = frozenset(
+    b"abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_")
 
 
 def strip_suffix(suffix: str, s: str) -> str:
@@ -99,6 +111,25 @@ class BinderServer:
         self.engine.on_query = self._on_query
         self.engine.on_after = self._on_after
 
+        # Native fast path: answer-cache hits served inside the C UDP
+        # drain (native/fastio/fastpath.c).  Python remains the source of
+        # truth — completed answer-cache entries are pushed down in
+        # _on_query, and the C-side counters fold into the same
+        # Prometheus collectors at scrape time (_fold_fastpath_metrics).
+        self._fastpath = None
+        self._fp_folded: dict = {}
+        self._fp_fold_lock = threading.Lock()
+        if (_fastio is not None and cache_size > 0
+                and hasattr(_fastio, "fastpath_new")):
+            self._fastpath = _fastio.fastpath_new(
+                cache_size, cache_expiry_ms,
+                [float(b) for b in self.latency_histogram.buckets],
+                [float(b) for b in self.size_histogram.buckets])
+            self.engine.fastpath = self._fastpath
+            self.engine.fastpath_gen = lambda: self.zk_cache.gen
+            self.engine.fastpath_gate = self._fastpath_active
+            self.collector.on_expose(self._fold_fastpath_metrics)
+
         # actual bound ports (for tests / ephemeral binds)
         self.udp_port: Optional[int] = None
         self.tcp_port: Optional[int] = None
@@ -147,10 +178,119 @@ class BinderServer:
             # reused by _on_after for this query's own log line too —
             # summaries are built exactly once per resolve
             query.cached_summary = (ans, add)
-            self.answer_cache.put(
-                key, self.zk_cache.gen, (query.wire, ans, add),
+            gen = self.zk_cache.gen
+            completed = self.answer_cache.put(
+                key, gen, (query.wire, ans, add),
                 rotatable=len(query.response.answers) > 1)
+            # push only while the C path can actually drain — with the
+            # gate closed (query_log on / probes attached) the native
+            # cache would just accumulate dead wires; after a runtime
+            # toggle it repopulates from misses within one expiry window
+            if (completed and self._fastpath is not None
+                    and query.udp_semantics and self._fastpath_active()):
+                self._fastpath_push(key, gen, query)
         return pending
+
+    def _fastpath_push(self, key, gen: int, query: QueryCtx) -> None:
+        """Hand a just-completed answer-cache entry to the native fast
+        path.  The C key is built from the request's raw qname bytes so
+        both key builders see identical input; names outside the
+        hostname charset (which Python decodes with replacement) are
+        skipped — they keep being served by the Python path."""
+        ckey = self._fastpath_key(query)
+        if ckey is None:
+            return
+        variants = self.answer_cache.variants(key, gen)
+        if not variants:
+            return
+        wires = [v[0] for v in variants]
+        try:
+            _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
+                                 gen, wires)
+        except (TypeError, ValueError, MemoryError) as e:
+            self.log.debug("fastpath push skipped: %s", e)
+
+    @staticmethod
+    def _fastpath_key(query: QueryCtx) -> Optional[bytes]:
+        # layout must match fp_build_key in native/fastio/fastpath.c:
+        # [flags rd|edns<<1][payload BE16][qtype BE16][qclass BE16][qname]
+        raw = query.raw
+        req = query.request
+        if raw is None or len(raw) < 17:
+            return None
+        off = 12
+        try:
+            while True:
+                label_len = raw[off]
+                if label_len == 0:
+                    off += 1
+                    break
+                if label_len & 0xC0:
+                    return None   # compressed question name: C punts too
+                label = raw[off + 1:off + 1 + label_len]
+                if (len(label) != label_len
+                        or not _FP_NAME_OK.issuperset(label)):
+                    return None
+                off += 1 + label_len
+                if off - 12 > 255:
+                    return None
+        except IndexError:
+            return None
+        qname = raw[12:off].lower()
+        q0 = req.questions[0]
+        flags = (1 if req.rd else 0) | (2 if req.edns is not None else 0)
+        return (bytes([flags]) + req.max_udp_payload().to_bytes(2, "big")
+                + q0.qtype.to_bytes(2, "big")
+                + q0.qclass.to_bytes(2, "big") + qname)
+
+    def _fold_fastpath_metrics(self) -> None:
+        """Fold the C fast path's monotonic counters into the Prometheus
+        collectors (registered as a pre-scrape hook).  Deltas are taken
+        against the last fold under a lock — concurrent scrapes must not
+        double-count."""
+        stats = _fastio.fastpath_stats(self._fastpath)
+        with self._fp_fold_lock:
+            last = self._fp_folded
+            hits_delta = stats["hits"] - last.get("hits", 0)
+            if hits_delta > 0:
+                self._cache_hit_child.inc(hits_delta)
+            last["hits"] = stats["hits"]
+            for qtype, s in stats["per_qtype"].items():
+                children = self._children_for(qtype)
+                prev = last.get(qtype)
+                count_delta = s["count"] - (prev["count"] if prev else 0)
+                if count_delta > 0:
+                    children[0].inc(count_delta)
+                    children[1].merge(
+                        [c - (prev["lat_cells"][i] if prev else 0)
+                         for i, c in enumerate(s["lat_cells"])],
+                        s["lat_sum"] - (prev["lat_sum"] if prev else 0.0))
+                    children[2].merge(
+                        [c - (prev["size_cells"][i] if prev else 0)
+                         for i, c in enumerate(s["size_cells"])],
+                        s["size_sum"] - (prev["size_sum"] if prev else 0.0))
+                last[qtype] = s
+
+    def _children_for(self, qtype: int):
+        """Pre-resolved (counter, latency, size) metric handles for a
+        qtype — label-sort once, not per query; shared by the after-hook
+        and the fast-path fold."""
+        children = self._metric_children.get(qtype)
+        if children is None:
+            labels = {"type": Type.name(qtype)}
+            children = (self.request_counter.labelled(labels),
+                        self.latency_histogram.labelled(labels),
+                        self.size_histogram.labelled(labels))
+            self._metric_children[qtype] = children
+        return children
+
+    def _fastpath_active(self) -> bool:
+        """The C path bypasses Python entirely, so it must stand down
+        whenever every query has to surface: per-query logging on, or a
+        probe consumer attached."""
+        return (not self.query_log
+                and not self.p_req_start.enabled
+                and not self.p_req_done.enabled)
 
     # -- after hook: metrics + query log (lib/server.js:509-591) --
 
@@ -166,13 +306,7 @@ class BinderServer:
             })
         level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
 
-        children = self._metric_children.get(query.qtype())
-        if children is None:
-            labels = {"type": query.qtype_name()}
-            children = (self.request_counter.labelled(labels),
-                        self.latency_histogram.labelled(labels),
-                        self.size_histogram.labelled(labels))
-            self._metric_children[query.qtype()] = children
+        children = self._children_for(query.qtype())
         children[0].inc()
         children[1].observe(lat_ms / 1000.0)
         children[2].observe(query.bytes_sent)

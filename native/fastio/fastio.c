@@ -37,11 +37,13 @@
 #include <string.h>
 #include <sys/socket.h>
 
+#include "fastpath.h"
+
 #define FASTIO_BATCH 64
 #define FASTIO_DGRAM_MAX 65535
 
-static PyObject *
-addr_to_tuple(const struct sockaddr_storage *ss)
+PyObject *
+fastio_addr_to_tuple(const struct sockaddr_storage *ss)
 {
     char host[INET6_ADDRSTRLEN];
 
@@ -153,7 +155,7 @@ fastio_recv_batch(PyObject *self, PyObject *args)
     for (int i = 0; i < n; i++) {
         PyObject *payload = PyBytes_FromStringAndSize(
             (const char *)bufs[i], (Py_ssize_t)msgs[i].msg_len);
-        PyObject *addr = payload ? addr_to_tuple(&addrs[i]) : NULL;
+        PyObject *addr = payload ? fastio_addr_to_tuple(&addrs[i]) : NULL;
         if (payload == NULL || addr == NULL) {
             Py_XDECREF(payload);
             Py_XDECREF(addr);
@@ -273,6 +275,16 @@ static PyMethodDef fastio_methods[] = {
      "recv_batch(fd, max_n=64) -> list[(bytes, (host, port))]"},
     {"send_batch", fastio_send_batch, METH_VARARGS,
      "send_batch(fd, msgs) -> int sent"},
+    {"fastpath_new", fastpath_new, METH_VARARGS,
+     "fastpath_new(size, expiry_ms, lat_buckets, size_buckets) -> capsule"},
+    {"fastpath_put", fastpath_put, METH_VARARGS,
+     "fastpath_put(cache, key, qtype, gen, wires) -> bool accepted"},
+    {"fastpath_drain", fastpath_drain, METH_VARARGS,
+     "fastpath_drain(cache, fd, gen, max_n=64) -> (misses, served)"},
+    {"fastpath_stats", fastpath_stats, METH_VARARGS,
+     "fastpath_stats(cache) -> dict"},
+    {"fastpath_clear", fastpath_clear, METH_VARARGS,
+     "fastpath_clear(cache) -> None"},
     {NULL, NULL, 0, NULL},
 };
 

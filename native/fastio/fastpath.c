@@ -1,0 +1,799 @@
+/*
+ * _binderfastio fast path — native encoded-answer cache for the UDP drain.
+ *
+ * The Python answer cache (binder_tpu/resolver/answer_cache.py) already
+ * makes repeat queries cheap; this moves the *hit* path out of Python
+ * entirely.  `fastpath_drain(fd)` replaces `recv_batch(fd)` on the UDP
+ * reader: it recvmmsg()s a batch, parses each question directly from the
+ * wire, looks it up in a native cache, and answers hits with one
+ * sendmmsg() — the Python event loop only ever sees the misses.  Python
+ * stays the source of truth: it resolves misses through the normal
+ * engine (binder_tpu/resolver/engine.py) and pushes the completed,
+ * fully-encoded response variants down with `fastpath_put`.
+ *
+ * Semantics preserved relative to the Python hit path
+ * (BinderServer._on_query):
+ *  - the key covers exactly the decoded fields the response depends on:
+ *    RD bit, EDNS presence, effective payload ceiling, qtype, qclass,
+ *    lowercased qname (wire label format).  EDNS option bytes (cookies,
+ *    padding) vary per packet and are deliberately NOT keyed;
+ *  - store-generation check: every entry records the mirror-cache
+ *    generation it was resolved under; drain() is handed the current
+ *    generation and treats stale entries as misses (lazy invalidation);
+ *  - time expiry (the reference's -a expiry flag, main.js:34-38);
+ *  - round-robin: multi-answer entries carry the shuffle variants the
+ *    Python cache collected and hits cycle through them;
+ *  - 0x20 case echo: the response's question section is patched with the
+ *    client's original bytes, so mixed-case (RFC draft-vixie-dnsext-dns0x20)
+ *    queries verify.
+ *
+ * Only plain hostname-charset names ([a-zA-Z0-9_-] labels) take the fast
+ * path; anything else — multi-question, non-zero opcode, compression in
+ * the question, unknown additionals, trailing bytes — falls through to
+ * Python, which is always correct.
+ *
+ * Queries answered here never reach the Python after-hook, so the cache
+ * keeps its own per-qtype counters and latency/size histogram cells
+ * (bucket bounds supplied by Python at construction, matching the
+ * Prometheus collectors); the server folds them in at scrape time.
+ * The fast path is only engaged when per-query logging and probes are
+ * off — with those on, every query must surface to Python.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <errno.h>
+#include <netinet/in.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+
+#include "fastpath.h"
+
+#define FP_BATCH 64
+#define FP_DGRAM_MAX 65535
+#define FP_MAX_VARIANTS 8
+#define FP_PROBE 8
+#define FP_MAX_WIRE 4096          /* larger responses stay in Python */
+#define FP_MAX_KEY 272            /* 7 fixed + 255 name + slack */
+#define FP_MAX_QTYPES 16
+#define FP_MAX_BUCKETS 24
+#define FP_MAX_TOTAL_BYTES (64u << 20)
+#define FP_CLASSIC_PAYLOAD 512    /* wire.py MAX_UDP_PAYLOAD */
+
+typedef struct {
+    uint8_t key[FP_MAX_KEY];
+    uint16_t keylen;
+    uint64_t gen;
+    double expire_at;
+    double inserted_at;
+    uint8_t n_variants;
+    uint8_t next_variant;
+    uint16_t qtype;
+    uint8_t *wires[FP_MAX_VARIANTS];
+    uint16_t wire_lens[FP_MAX_VARIANTS];
+    int used;
+} fp_entry_t;
+
+typedef struct {
+    uint16_t qtype;
+    uint64_t count;
+    double lat_sum;
+    double size_sum;
+    uint64_t lat_cells[FP_MAX_BUCKETS + 1];
+    uint64_t size_cells[FP_MAX_BUCKETS + 1];
+} fp_qstat_t;
+
+typedef struct {
+    fp_entry_t *slots;
+    uint32_t mask;            /* slot count - 1 (power of two) */
+    uint32_t n_entries;
+    uint64_t total_bytes;     /* wire bytes held */
+    double expiry_s;
+    double lat_buckets[FP_MAX_BUCKETS];
+    int n_lat_buckets;
+    double size_buckets[FP_MAX_BUCKETS];
+    int n_size_buckets;
+    fp_qstat_t qstats[FP_MAX_QTYPES];
+    int n_qstats;
+    uint64_t hits;
+    uint64_t lookups;
+} fp_cache_t;
+
+static const char *FP_CAPSULE_NAME = "binder_tpu._binderfastio.fastpath";
+
+static double
+fp_now(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+static uint64_t
+fp_hash(const uint8_t *key, size_t len)
+{
+    uint64_t h = 1469598103934665603ull;        /* FNV-1a 64 */
+    for (size_t i = 0; i < len; i++) {
+        h ^= key[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+static void
+fp_entry_free(fp_cache_t *c, fp_entry_t *e)
+{
+    for (int i = 0; i < e->n_variants; i++) {
+        c->total_bytes -= e->wire_lens[i];
+        free(e->wires[i]);
+        e->wires[i] = NULL;
+    }
+    e->n_variants = 0;
+    if (e->used) {
+        e->used = 0;
+        c->n_entries--;
+    }
+}
+
+static void
+fp_cache_free(fp_cache_t *c)
+{
+    if (c->slots != NULL) {
+        for (uint32_t i = 0; i <= c->mask; i++) {
+            if (c->slots[i].used)
+                fp_entry_free(c, &c->slots[i]);
+        }
+        free(c->slots);
+        c->slots = NULL;
+    }
+    free(c);
+}
+
+static void
+fp_capsule_destructor(PyObject *capsule)
+{
+    fp_cache_t *c = PyCapsule_GetPointer(capsule, FP_CAPSULE_NAME);
+    if (c != NULL)
+        fp_cache_free(c);
+}
+
+static fp_cache_t *
+fp_from_capsule(PyObject *capsule)
+{
+    return PyCapsule_GetPointer(capsule, FP_CAPSULE_NAME);
+}
+
+static int
+fp_load_buckets(PyObject *seq, double *out, int *n_out, const char *what)
+{
+    PyObject *fast = PySequence_Fast(seq, "buckets must be a sequence");
+    if (fast == NULL)
+        return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    if (n > FP_MAX_BUCKETS) {
+        Py_DECREF(fast);
+        PyErr_Format(PyExc_ValueError, "too many %s buckets (max %d)",
+                     what, FP_MAX_BUCKETS);
+        return -1;
+    }
+    double prev = -1.0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        double v = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(fast, i));
+        if (v == -1.0 && PyErr_Occurred()) {
+            Py_DECREF(fast);
+            return -1;
+        }
+        if (v <= prev) {
+            Py_DECREF(fast);
+            PyErr_Format(PyExc_ValueError,
+                         "%s buckets must be strictly increasing", what);
+            return -1;
+        }
+        out[i] = v;
+        prev = v;
+    }
+    *n_out = (int)n;
+    Py_DECREF(fast);
+    return 0;
+}
+
+static int
+fp_bucket_index(const double *buckets, int n, double v)
+{
+    /* first bucket with bound >= v; n == +Inf cell (matches Python's
+     * bisect_left non-cumulative cells in metrics/collector.py) */
+    int i = 0;
+    while (i < n && buckets[i] < v)
+        i++;
+    return i;
+}
+
+static fp_qstat_t *
+fp_qstat(fp_cache_t *c, uint16_t qtype)
+{
+    for (int i = 0; i < c->n_qstats; i++) {
+        if (c->qstats[i].qtype == qtype)
+            return &c->qstats[i];
+    }
+    if (c->n_qstats < FP_MAX_QTYPES) {
+        fp_qstat_t *s = &c->qstats[c->n_qstats++];
+        memset(s, 0, sizeof(*s));
+        s->qtype = qtype;
+        return s;
+    }
+    /* overflow: fold into the last slot (practically unreachable — a
+     * deployment serves a handful of qtypes) */
+    return &c->qstats[FP_MAX_QTYPES - 1];
+}
+
+/* ---------------- key construction / wire parsing ---------------- */
+
+/* charset a fast-path name label may use; anything else goes to Python
+ * (Python decodes arbitrary bytes with replacement, so only this safe
+ * subset round-trips identically between the two key builders) */
+static const uint8_t fp_name_ok[256] = {
+    ['a'] = 1, ['b'] = 1, ['c'] = 1, ['d'] = 1, ['e'] = 1, ['f'] = 1,
+    ['g'] = 1, ['h'] = 1, ['i'] = 1, ['j'] = 1, ['k'] = 1, ['l'] = 1,
+    ['m'] = 1, ['n'] = 1, ['o'] = 1, ['p'] = 1, ['q'] = 1, ['r'] = 1,
+    ['s'] = 1, ['t'] = 1, ['u'] = 1, ['v'] = 1, ['w'] = 1, ['x'] = 1,
+    ['y'] = 1, ['z'] = 1,
+    ['A'] = 1, ['B'] = 1, ['C'] = 1, ['D'] = 1, ['E'] = 1, ['F'] = 1,
+    ['G'] = 1, ['H'] = 1, ['I'] = 1, ['J'] = 1, ['K'] = 1, ['L'] = 1,
+    ['M'] = 1, ['N'] = 1, ['O'] = 1, ['P'] = 1, ['Q'] = 1, ['R'] = 1,
+    ['S'] = 1, ['T'] = 1, ['U'] = 1, ['V'] = 1, ['W'] = 1, ['X'] = 1,
+    ['Y'] = 1, ['Z'] = 1,
+    ['0'] = 1, ['1'] = 1, ['2'] = 1, ['3'] = 1, ['4'] = 1, ['5'] = 1,
+    ['6'] = 1, ['7'] = 1, ['8'] = 1, ['9'] = 1,
+    ['-'] = 1, ['_'] = 1,
+};
+
+static inline uint16_t
+rd16(const uint8_t *p)
+{
+    return (uint16_t)((p[0] << 8) | p[1]);
+}
+
+/*
+ * Parse a query packet far enough to build its cache key.  Returns the
+ * key length on success and fills key/qn_len/qtype; returns 0 when the
+ * packet must go to Python (not an error — just not fast-path eligible).
+ *
+ * Key layout (the Python pusher in binder_tpu/server.py builds the
+ * identical bytes — keep in lockstep):
+ *   [0]    flags: bit0 RD, bit1 EDNS-present
+ *   [1:3]  effective max UDP payload, big endian
+ *   [3:5]  qtype BE
+ *   [5:7]  qclass BE
+ *   [7:]   lowercased qname, wire label format incl. terminating 0x00
+ */
+static size_t
+fp_build_key(const uint8_t *buf, size_t len, uint8_t *key,
+             size_t *qn_len_out, uint16_t *qtype_out)
+{
+    if (len < 12 + 1 + 4)
+        return 0;
+    uint16_t flags = rd16(buf + 2);
+    if (flags & 0x8000)                 /* QR: a response */
+        return 0;
+    if ((flags >> 11) & 0xF)            /* opcode != QUERY */
+        return 0;
+    if (flags & 0x0200)                 /* TC on a query: let Python decide */
+        return 0;
+    uint16_t qd = rd16(buf + 4), an = rd16(buf + 6);
+    uint16_t ns = rd16(buf + 8), ar = rd16(buf + 10);
+    if (qd != 1 || an != 0 || ns != 0 || ar > 1)
+        return 0;
+
+    size_t off = 12;
+    uint8_t *kn = key + 7;
+    for (;;) {
+        if (off >= len)
+            return 0;
+        uint8_t l = buf[off];
+        if (l == 0) {
+            kn[off - 12] = 0;
+            off++;
+            break;
+        }
+        if (l & 0xC0)                   /* compressed/reserved label */
+            return 0;
+        if (off + 1 + l > len || (off - 12) + 1 + l > 255)
+            return 0;
+        kn[off - 12] = l;
+        for (uint8_t i = 1; i <= l; i++) {
+            uint8_t ch = buf[off + i];
+            if (!fp_name_ok[ch])
+                return 0;
+            /* ASCII lowercase */
+            kn[off - 12 + i] = (ch >= 'A' && ch <= 'Z') ? ch + 32 : ch;
+        }
+        off += 1 + (size_t)l;
+    }
+    size_t qn_len = off - 12;           /* includes terminator */
+    if (off + 4 > len)
+        return 0;
+    uint16_t qtype = rd16(buf + off), qclass = rd16(buf + off + 2);
+    off += 4;
+
+    int edns = 0;
+    unsigned payload = FP_CLASSIC_PAYLOAD;
+    if (ar == 1) {
+        /* exactly one additional, and it must be a root-name OPT that
+         * ends the packet (wire.py Message.decode tolerates more, but
+         * those shapes go to Python) */
+        if (off + 11 > len)
+            return 0;
+        if (buf[off] != 0)
+            return 0;
+        uint16_t rtype = rd16(buf + off + 1);
+        if (rtype != 41)                /* not OPT (e.g. TSIG) */
+            return 0;
+        uint16_t rclass = rd16(buf + off + 3);
+        uint16_t rdlen = rd16(buf + off + 9);
+        if (off + 11 + (size_t)rdlen != len)
+            return 0;
+        edns = 1;
+        /* wire.py Message.max_udp_payload: >=512 → min(size, 4096),
+         * else classic 512 */
+        payload = rclass >= 512 ? (rclass > 4096 ? 4096 : rclass)
+                                : FP_CLASSIC_PAYLOAD;
+    } else if (off != len) {
+        return 0;                       /* trailing bytes: Python decides */
+    }
+
+    key[0] = (uint8_t)(((flags & 0x0100) ? 1 : 0) | (edns ? 2 : 0));
+    key[1] = (uint8_t)(payload >> 8);
+    key[2] = (uint8_t)(payload & 0xFF);
+    key[3] = (uint8_t)(qtype >> 8);
+    key[4] = (uint8_t)(qtype & 0xFF);
+    key[5] = (uint8_t)(qclass >> 8);
+    key[6] = (uint8_t)(qclass & 0xFF);
+    *qn_len_out = qn_len;
+    *qtype_out = qtype;
+    return 7 + qn_len;
+}
+
+static fp_entry_t *
+fp_find(fp_cache_t *c, const uint8_t *key, size_t keylen, uint64_t gen,
+        double now)
+{
+    uint64_t h = fp_hash(key, keylen);
+    for (int p = 0; p < FP_PROBE; p++) {
+        fp_entry_t *e = &c->slots[(h + (uint64_t)p) & c->mask];
+        if (!e->used)
+            continue;
+        if (e->keylen != keylen || memcmp(e->key, key, keylen) != 0)
+            continue;
+        if (e->gen != gen || now > e->expire_at) {
+            fp_entry_free(c, e);        /* lazy invalidation */
+            return NULL;
+        }
+        return e;
+    }
+    return NULL;
+}
+
+/* ---------------- module functions ---------------- */
+
+PyObject *
+fastpath_new(PyObject *self, PyObject *args)
+{
+    (void)self;
+    long size;
+    long expiry_ms;
+    PyObject *lat_buckets, *size_buckets;
+
+    if (!PyArg_ParseTuple(args, "llOO", &size, &expiry_ms,
+                          &lat_buckets, &size_buckets))
+        return NULL;
+    if (size < 1) {
+        PyErr_SetString(PyExc_ValueError, "size must be >= 1");
+        return NULL;
+    }
+    fp_cache_t *c = calloc(1, sizeof(*c));
+    if (c == NULL)
+        return PyErr_NoMemory();
+    /* 2x capacity so the probe window rarely fills before `size`
+     * distinct keys are live */
+    uint64_t want = 64;
+    while (want < (uint64_t)size * 2 && want < (1u << 24))
+        want <<= 1;
+    c->slots = calloc(want, sizeof(fp_entry_t));
+    if (c->slots == NULL) {
+        free(c);
+        return PyErr_NoMemory();
+    }
+    c->mask = (uint32_t)(want - 1);
+    c->expiry_s = (double)expiry_ms / 1000.0;
+    if (fp_load_buckets(lat_buckets, c->lat_buckets,
+                        &c->n_lat_buckets, "latency") < 0 ||
+        fp_load_buckets(size_buckets, c->size_buckets,
+                        &c->n_size_buckets, "size") < 0) {
+        fp_cache_free(c);
+        return NULL;
+    }
+    PyObject *capsule = PyCapsule_New(c, FP_CAPSULE_NAME,
+                                      fp_capsule_destructor);
+    if (capsule == NULL) {
+        fp_cache_free(c);
+        return NULL;
+    }
+    return capsule;
+}
+
+PyObject *
+fastpath_put(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule, *wires;
+    Py_buffer keybuf;
+    unsigned long long gen;
+    int qtype;
+
+    if (!PyArg_ParseTuple(args, "Oy*iKO", &capsule, &keybuf, &qtype,
+                          &gen, &wires))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&keybuf);
+        return NULL;
+    }
+    if (keybuf.len < 8 || keybuf.len > FP_MAX_KEY) {
+        PyBuffer_Release(&keybuf);
+        Py_RETURN_FALSE;                /* not representable: skip */
+    }
+    PyObject *fast = PySequence_Fast(wires, "wires must be a sequence");
+    if (fast == NULL) {
+        PyBuffer_Release(&keybuf);
+        return NULL;
+    }
+    Py_ssize_t nw = PySequence_Fast_GET_SIZE(fast);
+    if (nw < 1 || nw > FP_MAX_VARIANTS) {
+        Py_DECREF(fast);
+        PyBuffer_Release(&keybuf);
+        Py_RETURN_FALSE;
+    }
+    /* validate + measure before touching the table */
+    uint64_t add_bytes = 0;
+    for (Py_ssize_t i = 0; i < nw; i++) {
+        char *data;
+        Py_ssize_t dlen;
+        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                    &data, &dlen) < 0) {
+            Py_DECREF(fast);
+            PyBuffer_Release(&keybuf);
+            return NULL;
+        }
+        if (dlen < 12 || dlen > FP_MAX_WIRE) {
+            Py_DECREF(fast);
+            PyBuffer_Release(&keybuf);
+            Py_RETURN_FALSE;            /* oversize answers stay in Python */
+        }
+        add_bytes += (uint64_t)dlen;
+    }
+    if (c->total_bytes + add_bytes > FP_MAX_TOTAL_BYTES) {
+        Py_DECREF(fast);
+        PyBuffer_Release(&keybuf);
+        Py_RETURN_FALSE;
+    }
+
+    const uint8_t *key = keybuf.buf;
+    size_t keylen = (size_t)keybuf.len;
+    double now = fp_now();
+    uint64_t h = fp_hash(key, keylen);
+    fp_entry_t *target = NULL, *oldest = NULL;
+    for (int p = 0; p < FP_PROBE; p++) {
+        fp_entry_t *e = &c->slots[(h + (uint64_t)p) & c->mask];
+        if (e->used && e->keylen == keylen &&
+            memcmp(e->key, key, keylen) == 0) {
+            target = e;                 /* replace in place */
+            break;
+        }
+        if (!e->used) {
+            if (target == NULL)
+                target = e;
+            continue;
+        }
+        if (oldest == NULL || e->inserted_at < oldest->inserted_at)
+            oldest = e;
+    }
+    if (target == NULL)
+        target = oldest;                /* probe window full: evict oldest */
+    if (target->used)
+        fp_entry_free(c, target);
+
+    memcpy(target->key, key, keylen);
+    target->keylen = (uint16_t)keylen;
+    target->gen = (uint64_t)gen;
+    target->inserted_at = now;
+    target->expire_at = now + c->expiry_s;
+    target->next_variant = 0;
+    target->qtype = (uint16_t)qtype;
+    target->n_variants = 0;
+    for (Py_ssize_t i = 0; i < nw; i++) {
+        char *data;
+        Py_ssize_t dlen;
+        PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                &data, &dlen);   /* validated above */
+        uint8_t *copy = malloc((size_t)dlen);
+        if (copy == NULL) {
+            fp_entry_free(c, target);
+            Py_DECREF(fast);
+            PyBuffer_Release(&keybuf);
+            return PyErr_NoMemory();
+        }
+        memcpy(copy, data, (size_t)dlen);
+        target->wires[i] = copy;
+        target->wire_lens[i] = (uint16_t)dlen;
+        target->n_variants = (uint8_t)(i + 1);
+        c->total_bytes += (uint64_t)dlen;
+    }
+    target->used = 1;
+    c->n_entries++;
+    Py_DECREF(fast);
+    PyBuffer_Release(&keybuf);
+    Py_RETURN_TRUE;
+}
+
+PyObject *
+fastpath_drain(PyObject *self, PyObject *args)
+{
+    (void)self;
+    int fd, max_n = FP_BATCH;
+    PyObject *capsule;
+    unsigned long long gen;
+
+    if (!PyArg_ParseTuple(args, "OiK|i", &capsule, &fd, &gen, &max_n))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL)
+        return NULL;
+    if (max_n < 1) max_n = 1;
+    if (max_n > FP_BATCH) max_n = FP_BATCH;
+
+    /* arenas are static: the GIL is held for the whole call */
+    static unsigned char bufs[FP_BATCH][FP_DGRAM_MAX];
+    static unsigned char outs[FP_BATCH][FP_MAX_WIRE];
+    struct mmsghdr msgs[FP_BATCH];
+    struct iovec iovs[FP_BATCH];
+    struct sockaddr_storage addrs[FP_BATCH];
+
+    memset(msgs, 0, sizeof(struct mmsghdr) * (size_t)max_n);
+    for (int i = 0; i < max_n; i++) {
+        iovs[i].iov_base = bufs[i];
+        iovs[i].iov_len = FP_DGRAM_MAX;
+        msgs[i].msg_hdr.msg_iov = &iovs[i];
+        msgs[i].msg_hdr.msg_iovlen = 1;
+        msgs[i].msg_hdr.msg_name = &addrs[i];
+        msgs[i].msg_hdr.msg_namelen = sizeof(addrs[i]);
+    }
+
+    double t0 = fp_now();
+    int n = recvmmsg(fd, msgs, (unsigned)max_n, MSG_DONTWAIT, NULL);
+    if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+            PyObject *empty = PyList_New(0);
+            if (empty == NULL)
+                return NULL;
+            PyObject *r = Py_BuildValue("(Ni)", empty, 0);
+            return r;
+        }
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+
+    PyObject *misses = PyList_New(0);
+    if (misses == NULL)
+        return NULL;
+
+    struct mmsghdr omsgs[FP_BATCH];
+    struct iovec oiovs[FP_BATCH];
+    int n_hits = 0;
+    int batch_qtype_counts[FP_MAX_QTYPES];
+    memset(batch_qtype_counts, 0, sizeof(batch_qtype_counts));
+    memset(omsgs, 0, sizeof(omsgs[0]) * (size_t)(n > 0 ? n : 1));
+
+    for (int i = 0; i < n; i++) {
+        const uint8_t *pkt = bufs[i];
+        size_t plen = msgs[i].msg_len;
+        uint8_t key[FP_MAX_KEY];
+        size_t qn_len = 0;
+        uint16_t qtype = 0;
+        fp_entry_t *e = NULL;
+
+        c->lookups++;
+        size_t keylen = fp_build_key(pkt, plen, key, &qn_len, &qtype);
+        if (keylen != 0)
+            e = fp_find(c, key, keylen, (uint64_t)gen, t0);
+        if (e == NULL) {
+            /* miss: surface to Python exactly like recv_batch */
+            PyObject *payload = PyBytes_FromStringAndSize(
+                (const char *)pkt, (Py_ssize_t)plen);
+            PyObject *addr = payload
+                ? fastio_addr_to_tuple(&addrs[i]) : NULL;
+            if (payload == NULL || addr == NULL) {
+                Py_XDECREF(payload);
+                Py_XDECREF(addr);
+                Py_DECREF(misses);
+                return NULL;
+            }
+            PyObject *item = PyTuple_Pack(2, payload, addr);
+            Py_DECREF(payload);
+            Py_DECREF(addr);
+            if (item == NULL || PyList_Append(misses, item) < 0) {
+                Py_XDECREF(item);
+                Py_DECREF(misses);
+                return NULL;
+            }
+            Py_DECREF(item);
+            continue;
+        }
+
+        /* hit: copy the variant, patch id + the client's question bytes
+         * (same length by construction — key match implies identical
+         * lowercased label structure) */
+        uint8_t v = e->next_variant;
+        e->next_variant = (uint8_t)((v + 1) % e->n_variants);
+        const uint8_t *wire = e->wires[v];
+        size_t wlen = e->wire_lens[v];
+        if (wlen < 12 + qn_len + 4) {
+            /* defensive: a cached response must embed the question */
+            fp_entry_free(c, e);
+            PyObject *payload = PyBytes_FromStringAndSize(
+                (const char *)pkt, (Py_ssize_t)plen);
+            PyObject *addr = payload
+                ? fastio_addr_to_tuple(&addrs[i]) : NULL;
+            PyObject *item = (payload && addr)
+                ? PyTuple_Pack(2, payload, addr) : NULL;
+            Py_XDECREF(payload);
+            Py_XDECREF(addr);
+            if (item == NULL || PyList_Append(misses, item) < 0) {
+                Py_XDECREF(item);
+                Py_DECREF(misses);
+                return NULL;
+            }
+            Py_DECREF(item);
+            continue;
+        }
+        uint8_t *out = outs[n_hits];
+        memcpy(out, wire, wlen);
+        out[0] = pkt[0];
+        out[1] = pkt[1];
+        memcpy(out + 12, pkt + 12, qn_len + 4);
+
+        oiovs[n_hits].iov_base = out;
+        oiovs[n_hits].iov_len = wlen;
+        omsgs[n_hits].msg_hdr.msg_iov = &oiovs[n_hits];
+        omsgs[n_hits].msg_hdr.msg_iovlen = 1;
+        omsgs[n_hits].msg_hdr.msg_name = &addrs[i];
+        omsgs[n_hits].msg_hdr.msg_namelen = msgs[i].msg_hdr.msg_namelen;
+        n_hits++;
+
+        c->hits++;
+        fp_qstat_t *qs = fp_qstat(c, e->qtype);
+        qs->size_sum += (double)wlen;
+        qs->size_cells[fp_bucket_index(c->size_buckets,
+                                       c->n_size_buckets,
+                                       (double)wlen)]++;
+        batch_qtype_counts[(int)(qs - c->qstats)]++;
+    }
+
+    /* flush hits; per-destination errors skip one datagram and continue
+     * (same policy as send_batch — one unreachable client must not drop
+     * other clients' responses) */
+    int off = 0;
+    while (off < n_hits) {
+        int sent = sendmmsg(fd, omsgs + off, (unsigned)(n_hits - off),
+                            MSG_DONTWAIT);
+        if (sent >= 0) {
+            off += sent > 0 ? sent : 1;
+            continue;
+        }
+        if (errno == EINTR)
+            continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+            break;                      /* buffer full: drop rest (UDP) */
+        if (errno == EBADF || errno == ENOTSOCK || errno == EFAULT ||
+            errno == ENOMEM) {
+            Py_DECREF(misses);
+            return PyErr_SetFromErrno(PyExc_OSError);
+        }
+        off += 1;                       /* per-destination failure */
+    }
+
+    /* latency: the whole batch window, attributed to each hit — an
+     * upper bound (a hit waited at most recv..send of its batch) */
+    if (n_hits > 0) {
+        double elapsed = fp_now() - t0;
+        int li = fp_bucket_index(c->lat_buckets, c->n_lat_buckets,
+                                 elapsed);
+        for (int s = 0; s < FP_MAX_QTYPES; s++) {
+            int cnt = batch_qtype_counts[s];
+            if (cnt > 0) {
+                c->qstats[s].count += (uint64_t)cnt;
+                c->qstats[s].lat_sum += elapsed * (double)cnt;
+                c->qstats[s].lat_cells[li] += (uint64_t)cnt;
+            }
+        }
+    }
+
+    return Py_BuildValue("(Ni)", misses, n_hits);
+}
+
+PyObject *
+fastpath_stats(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL)
+        return NULL;
+
+    PyObject *per = PyDict_New();
+    if (per == NULL)
+        return NULL;
+    for (int i = 0; i < c->n_qstats; i++) {
+        fp_qstat_t *s = &c->qstats[i];
+        PyObject *lat = PyTuple_New(c->n_lat_buckets + 1);
+        PyObject *sz = PyTuple_New(c->n_size_buckets + 1);
+        if (lat == NULL || sz == NULL) {
+            Py_XDECREF(lat);
+            Py_XDECREF(sz);
+            Py_DECREF(per);
+            return NULL;
+        }
+        for (int b = 0; b <= c->n_lat_buckets; b++)
+            PyTuple_SET_ITEM(lat, b,
+                             PyLong_FromUnsignedLongLong(s->lat_cells[b]));
+        for (int b = 0; b <= c->n_size_buckets; b++)
+            PyTuple_SET_ITEM(sz, b,
+                             PyLong_FromUnsignedLongLong(s->size_cells[b]));
+        PyObject *d = Py_BuildValue(
+            "{s:K,s:d,s:N,s:d,s:N}",
+            "count", (unsigned long long)s->count,
+            "lat_sum", s->lat_sum, "lat_cells", lat,
+            "size_sum", s->size_sum, "size_cells", sz);
+        if (d == NULL) {
+            Py_DECREF(per);
+            return NULL;
+        }
+        PyObject *k = PyLong_FromLong((long)s->qtype);
+        int rc = k == NULL ? -1 : PyDict_SetItem(per, k, d);
+        Py_XDECREF(k);
+        Py_DECREF(d);
+        if (rc < 0) {
+            Py_DECREF(per);
+            return NULL;
+        }
+    }
+    return Py_BuildValue(
+        "{s:K,s:K,s:I,s:K,s:N}",
+        "hits", (unsigned long long)c->hits,
+        "lookups", (unsigned long long)c->lookups,
+        "entries", (unsigned)c->n_entries,
+        "bytes", (unsigned long long)c->total_bytes,
+        "per_qtype", per);
+}
+
+PyObject *
+fastpath_clear(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL)
+        return NULL;
+    for (uint32_t i = 0; i <= c->mask; i++) {
+        if (c->slots[i].used)
+            fp_entry_free(c, &c->slots[i]);
+    }
+    Py_RETURN_NONE;
+}

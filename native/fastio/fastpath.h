@@ -1,0 +1,23 @@
+/*
+ * Shared declarations between fastio.c (module definition, batched
+ * recv/send) and fastpath.c (native answer cache).
+ */
+#ifndef BINDER_FASTPATH_H
+#define BINDER_FASTPATH_H
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <sys/socket.h>
+
+/* fastio.c */
+PyObject *fastio_addr_to_tuple(const struct sockaddr_storage *ss);
+
+/* fastpath.c */
+PyObject *fastpath_new(PyObject *self, PyObject *args);
+PyObject *fastpath_put(PyObject *self, PyObject *args);
+PyObject *fastpath_drain(PyObject *self, PyObject *args);
+PyObject *fastpath_stats(PyObject *self, PyObject *args);
+PyObject *fastpath_clear(PyObject *self, PyObject *args);
+
+#endif /* BINDER_FASTPATH_H */
