@@ -34,7 +34,8 @@ def safe_unlink(path: str, log: logging.Logger) -> None:
         log.warning("unlinking socket path %s: %s", path, e)
 
 
-def make_store(options: Dict[str, object], log: logging.Logger):
+def make_store(options: Dict[str, object], log: logging.Logger,
+               collector=None):
     """Select the coordination-store backend from config."""
     store_cfg = options.get("store") or {}
     backend = store_cfg.get("backend", "zookeeper")
@@ -59,6 +60,7 @@ def make_store(options: Dict[str, object], log: logging.Logger):
             port=int(store_cfg.get("port", 2181)),
             session_timeout_ms=int(store_cfg.get("sessionTimeout", 30000)),
             log=log,
+            collector=collector,
         )
     raise ConfigError(f"unknown store backend: {backend}")
 
@@ -82,8 +84,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
     metrics.start()
     log.info("metrics server started on port %d", metrics.port)
 
-    store = make_store(options, log)
-    cache = MirrorCache(store, str(options["dnsDomain"]), log=log)
+    store = make_store(options, log, collector=collector)
+    cache = MirrorCache(store, str(options["dnsDomain"]), log=log,
+                        collector=collector)
 
     recursion = None
     if options.get("recursion"):

@@ -84,6 +84,53 @@ class Counter:
         return "\n".join(lines)
 
 
+class Gauge:
+    """Point-in-time value collector.  Besides ``set()``, a label set can
+    be bound to a callable sampled at scrape time (``set_function``) —
+    how structural values like mirrored-node counts are exported without
+    bookkeeping on the mutation paths (the reference gets the analogous
+    zkstream client gauges for free by passing its artedi collector in,
+    ``lib/zk.js:26-38``)."""
+
+    def __init__(self, name: str, help: str) -> None:
+        self.name = name
+        self.help = help
+        self._values: Dict[Tuple, float] = {}
+        self._functions: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def set_function(self, fn, labels: Optional[Dict[str, str]] = None) \
+            -> None:
+        with self._lock:
+            self._functions[_labels_key(labels)] = fn
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        key = _labels_key(labels)
+        fn = self._functions.get(key)
+        if fn is not None:
+            return float(fn())
+        return self._values.get(key, 0.0)
+
+    def expose(self, static: Tuple[Tuple[str, str], ...] = ()) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            merged = dict(self._values)
+            for key, fn in self._functions.items():
+                try:
+                    merged[key] = float(fn())
+                except Exception:  # noqa: BLE001 — one bad sampler must
+                    continue       # not take down the whole scrape
+        for key, v in sorted(merged.items()):
+            lines.append(f"{self.name}{_fmt_labels(static + key)} {v:g}")
+        return "\n".join(lines)
+
+
 class HistogramChild:
     """Pre-resolved label handle.  ``observe`` touches exactly one
     (non-cumulative) bucket cell via bisect instead of incrementing every
@@ -196,6 +243,13 @@ class MetricsCollector:
             h = Histogram(name, help, buckets)
             self._collectors[name] = h
         return h  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        g = self._collectors.get(name)
+        if g is None:
+            g = Gauge(name, help)
+            self._collectors[name] = g
+        return g  # type: ignore[return-value]
 
     def get(self, name: str):
         return self._collectors.get(name)

@@ -89,5 +89,15 @@ class AnswerCache:
             return None
         return list(e[3])
 
+    def remaining_ttl_ms(self, key, gen: int) -> Optional[float]:
+        """Milliseconds until this entry's time expiry — a late-completed
+        rotatable entry must carry its *remaining* lifetime into the
+        native fast path, not a fresh full window."""
+        e = self._entries.get(key)
+        if e is None or e[0] != gen:
+            return None
+        return max(0.0, (self.expiry_s - (time.monotonic() - e[1]))
+                   * 1000.0)
+
     def clear(self) -> None:
         self._entries.clear()

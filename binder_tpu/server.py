@@ -204,9 +204,11 @@ class BinderServer:
         if not variants:
             return
         wires = [v[0] for v in variants]
+        ttl_ms = self.answer_cache.remaining_ttl_ms(key, gen)
         try:
             _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
-                                 gen, wires)
+                                 gen, wires,
+                                 -1 if ttl_ms is None else int(ttl_ms))
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("fastpath push skipped: %s", e)
 
@@ -277,7 +279,9 @@ class BinderServer:
         and the fast-path fold."""
         children = self._metric_children.get(qtype)
         if children is None:
-            labels = {"type": Type.name(qtype)}
+            # 0xFFFF is the C stats catch-all past its per-qtype slots
+            labels = {"type": "other" if qtype == 0xFFFF
+                      else Type.name(qtype)}
             children = (self.request_counter.labelled(labels),
                         self.latency_histogram.labelled(labels),
                         self.size_histogram.labelled(labels))

@@ -75,6 +75,8 @@ class TreeNode:
 
     def on_children_changed(self, kids: List[str]) -> None:
         self.cache.gen += 1
+        if self.cache.m_watch_children is not None:
+            self.cache.m_watch_children.inc()
         new_kids: Dict[str, TreeNode] = {}
         for kid in kids:
             existing = self.kids.pop(kid, None)
@@ -90,11 +92,15 @@ class TreeNode:
 
     def on_data_changed(self, data: bytes) -> None:
         self.cache.gen += 1
+        if self.cache.m_watch_data is not None:
+            self.cache.m_watch_data.inc()
         try:
             parsed = json.loads(data.decode("utf-8")) if data else None
         except (ValueError, UnicodeDecodeError) as e:
             self.log.warning("ignoring node %s: failed to parse data: %s",
                              self.path, e)
+            if self.cache.m_parse_failures is not None:
+                self.cache.m_parse_failures.inc()
             return
         # JS typeof-object check admits dicts, lists, and null
         # (lib/zk.js:149-154); anything else is ignored, keeping old data.
@@ -163,7 +169,8 @@ class MirrorCache:
     """The ZKCache equivalent: domain-keyed node index + reverse-IP index."""
 
     def __init__(self, store: StoreClient, domain: str,
-                 log: Optional[logging.Logger] = None) -> None:
+                 log: Optional[logging.Logger] = None,
+                 collector=None) -> None:
         self.store = store
         self.domain = domain.lower()
         self.log = log or logging.getLogger("binder.cache")
@@ -172,6 +179,41 @@ class MirrorCache:
         # generation counter: bumped on every mirrored mutation so answer
         # caches layered above can invalidate without scanning
         self.gen = 0
+        # store-mirror observability (the reference gets the analogous
+        # client metrics by passing its artedi collector into zkstream,
+        # lib/zk.js:26-38); all optional — tests build bare caches
+        self.m_watch_children = self.m_watch_data = None
+        self.m_parse_failures = self.m_rebuilds = None
+        if collector is not None:
+            self.m_watch_children = collector.counter(
+                "binder_store_watch_events",
+                "store watch events applied to the mirror").labelled(
+                    {"kind": "children"})
+            self.m_watch_data = collector.counter(
+                "binder_store_watch_events", "").labelled({"kind": "data"})
+            self.m_parse_failures = collector.counter(
+                "binder_store_node_parse_failures",
+                "znodes whose JSON could not be applied").labelled()
+            self.m_rebuilds = collector.counter(
+                "binder_store_session_rebuilds",
+                "full mirror rebuilds triggered by store session events"
+            ).labelled()
+            collector.gauge(
+                "binder_store_mirrored_nodes",
+                "domain nodes currently mirrored from the store"
+            ).set_function(lambda: len(self.nodes))
+            collector.gauge(
+                "binder_store_reverse_entries",
+                "IP addresses in the PTR reverse index"
+            ).set_function(lambda: len(self.rev_lookup))
+            collector.gauge(
+                "binder_store_generation",
+                "mirror mutation generation counter"
+            ).set_function(lambda: self.gen)
+            collector.gauge(
+                "binder_store_ready",
+                "1 when the mirror has a live session and root node"
+            ).set_function(lambda: 1.0 if self.is_ready() else 0.0)
         store.on_session(self.rebuild)
 
     def is_ready(self) -> bool:
@@ -186,6 +228,8 @@ class MirrorCache:
     def rebuild(self) -> None:
         """Re-mirror from scratch-or-current on (re)session
         (lib/zk.js:68-76)."""
+        if self.m_rebuilds is not None:
+            self.m_rebuilds.inc()
         tn = self.nodes.get(self.domain)
         if tn is None:
             parts = self.domain.split(".")

@@ -53,11 +53,33 @@ class _ZKWatcher(Watcher):
 class ZKClient(StoreClient):
     def __init__(self, address: str = "127.0.0.1", port: int = 2181,
                  session_timeout_ms: int = 30000,
-                 log: Optional[logging.Logger] = None) -> None:
+                 log: Optional[logging.Logger] = None,
+                 collector=None) -> None:
         self.address = address
         self.port = port
         self.session_timeout_ms = session_timeout_ms
         self.log = log or logging.getLogger("binder.zk")
+
+        # client observability (zkstream publishes the analogous metrics
+        # through the shared artedi collector, reference lib/zk.js:26-38)
+        self.m_sessions = self.m_requests = self.m_notifications = None
+        if collector is not None:
+            self.m_sessions = collector.counter(
+                "binder_zk_sessions_established",
+                "ZooKeeper sessions established (1 + reconnects)").labelled()
+            self.m_requests = collector.counter(
+                "binder_zk_requests", "ZooKeeper requests sent").labelled()
+            self.m_notifications = collector.counter(
+                "binder_zk_watch_notifications",
+                "ZooKeeper watch notifications received").labelled()
+            collector.gauge(
+                "binder_zk_connected",
+                "1 while the ZooKeeper session is live"
+            ).set_function(lambda: 1.0 if self._connected else 0.0)
+            collector.gauge(
+                "binder_zk_outstanding_requests",
+                "requests awaiting a ZooKeeper response"
+            ).set_function(lambda: len(self._pending))
 
         self._session_cbs: List[Callable[[], None]] = []
         self._watchers: Dict[str, _ZKWatcher] = {}
@@ -159,6 +181,8 @@ class ZKClient(StoreClient):
             self._passwd = passwd
             self._negotiated_timeout = timeout
             self._connected = True
+            if self.m_sessions is not None:
+                self.m_sessions.inc()
             self.log.info("zk: session 0x%x established (timeout %dms)",
                           session_id, timeout)
 
@@ -244,6 +268,8 @@ class ZKClient(StoreClient):
         xid = self._xid
         fut = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
+        if self.m_requests is not None:
+            self.m_requests.inc()
         self._send(xid, opcode, body)
         return await fut
 
@@ -351,6 +377,8 @@ class ZKClient(StoreClient):
             self._exists_watch.discard(path)
 
     def _on_watch_event(self, etype: int, path: str) -> None:
+        if self.m_notifications is not None:
+            self.m_notifications.inc()
         self._exists_watch.discard(path)
         if etype == EventType.CREATED:
             self._schedule_sync(path, "children")

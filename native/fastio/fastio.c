@@ -39,8 +39,7 @@
 
 #include "fastpath.h"
 
-#define FASTIO_BATCH 64
-#define FASTIO_DGRAM_MAX 65535
+unsigned char fastio_shared_bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
 
 PyObject *
 fastio_addr_to_tuple(const struct sockaddr_storage *ss)
@@ -123,10 +122,10 @@ fastio_recv_batch(PyObject *self, PyObject *args)
     if (max_n < 1) max_n = 1;
     if (max_n > FASTIO_BATCH) max_n = FASTIO_BATCH;
 
-    /* static payload arena reused across calls; safe because the GIL is
+    /* shared payload arena reused across calls; safe because the GIL is
      * held for the whole call (MSG_DONTWAIT never blocks, so there is
      * nothing to gain from releasing it) */
-    static unsigned char bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
+    unsigned char (*bufs)[FASTIO_DGRAM_MAX] = fastio_shared_bufs;
     struct mmsghdr msgs[FASTIO_BATCH];
     struct iovec iovs[FASTIO_BATCH];
     struct sockaddr_storage addrs[FASTIO_BATCH];

@@ -52,8 +52,7 @@
 
 #include "fastpath.h"
 
-#define FP_BATCH 64
-#define FP_DGRAM_MAX 65535
+#define FP_BATCH FASTIO_BATCH
 #define FP_MAX_VARIANTS 8
 #define FP_PROBE 8
 #define FP_MAX_WIRE 4096          /* larger responses stay in Python */
@@ -62,6 +61,7 @@
 #define FP_MAX_BUCKETS 24
 #define FP_MAX_TOTAL_BYTES (64u << 20)
 #define FP_CLASSIC_PAYLOAD 512    /* wire.py MAX_UDP_PAYLOAD */
+#define FP_QTYPE_OTHER 0xFFFF     /* stats catch-all past FP_MAX_QTYPES */
 
 typedef struct {
     uint8_t key[FP_MAX_KEY];
@@ -218,15 +218,22 @@ fp_qstat(fp_cache_t *c, uint16_t qtype)
         if (c->qstats[i].qtype == qtype)
             return &c->qstats[i];
     }
-    if (c->n_qstats < FP_MAX_QTYPES) {
+    if (c->n_qstats < FP_MAX_QTYPES - 1) {
         fp_qstat_t *s = &c->qstats[c->n_qstats++];
         memset(s, 0, sizeof(*s));
         s->qtype = qtype;
         return s;
     }
-    /* overflow: fold into the last slot (practically unreachable — a
-     * deployment serves a handful of qtypes) */
-    return &c->qstats[FP_MAX_QTYPES - 1];
+    /* overflow: the final slot is a dedicated catch-all labeled with the
+     * sentinel qtype (folded as "other" by the server) — a client
+     * cycling many qtypes must not misattribute counts to a real type */
+    fp_qstat_t *s = &c->qstats[FP_MAX_QTYPES - 1];
+    if (c->n_qstats < FP_MAX_QTYPES) {
+        memset(s, 0, sizeof(*s));
+        s->qtype = FP_QTYPE_OTHER;
+        c->n_qstats = FP_MAX_QTYPES;
+    }
+    return s;
 }
 
 /* ---------------- key construction / wire parsing ---------------- */
@@ -356,6 +363,26 @@ fp_build_key(const uint8_t *buf, size_t len, uint8_t *key,
     return 7 + qn_len;
 }
 
+/* Append (payload, addr) to the miss list in recv_batch's item format.
+ * Returns 0 on success; -1 with a Python exception set. */
+static int
+surface_miss(PyObject *misses, const uint8_t *pkt, size_t plen,
+             const struct sockaddr_storage *addr)
+{
+    PyObject *payload = PyBytes_FromStringAndSize((const char *)pkt,
+                                                  (Py_ssize_t)plen);
+    PyObject *addr_t = payload ? fastio_addr_to_tuple(addr) : NULL;
+    PyObject *item = (payload && addr_t)
+        ? PyTuple_Pack(2, payload, addr_t) : NULL;
+    Py_XDECREF(payload);
+    Py_XDECREF(addr_t);
+    if (item == NULL)
+        return -1;
+    int rc = PyList_Append(misses, item);
+    Py_DECREF(item);
+    return rc;
+}
+
 static fp_entry_t *
 fp_find(fp_cache_t *c, const uint8_t *key, size_t keylen, uint64_t gen,
         double now)
@@ -432,9 +459,10 @@ fastpath_put(PyObject *self, PyObject *args)
     Py_buffer keybuf;
     unsigned long long gen;
     int qtype;
+    long expiry_ms = -1;   /* default: the cache-wide expiry */
 
-    if (!PyArg_ParseTuple(args, "Oy*iKO", &capsule, &keybuf, &qtype,
-                          &gen, &wires))
+    if (!PyArg_ParseTuple(args, "Oy*iKO|l", &capsule, &keybuf, &qtype,
+                          &gen, &wires, &expiry_ms))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     if (c == NULL) {
@@ -509,7 +537,10 @@ fastpath_put(PyObject *self, PyObject *args)
     target->keylen = (uint16_t)keylen;
     target->gen = (uint64_t)gen;
     target->inserted_at = now;
-    target->expire_at = now + c->expiry_s;
+    /* the pusher may hand down the *remaining* lifetime so an entry
+     * completed late in its Python-cache life can't live ~2x expiry */
+    target->expire_at = now + (expiry_ms >= 0 ? (double)expiry_ms / 1000.0
+                                              : c->expiry_s);
     target->next_variant = 0;
     target->qtype = (uint16_t)qtype;
     target->n_variants = 0;
@@ -554,8 +585,9 @@ fastpath_drain(PyObject *self, PyObject *args)
     if (max_n < 1) max_n = 1;
     if (max_n > FP_BATCH) max_n = FP_BATCH;
 
-    /* arenas are static: the GIL is held for the whole call */
-    static unsigned char bufs[FP_BATCH][FP_DGRAM_MAX];
+    /* receive arena shared with recv_batch (GIL-serialized); the
+     * response arena is fast-path-only */
+    unsigned char (*bufs)[FASTIO_DGRAM_MAX] = fastio_shared_bufs;
     static unsigned char outs[FP_BATCH][FP_MAX_WIRE];
     struct mmsghdr msgs[FP_BATCH];
     struct iovec iovs[FP_BATCH];
@@ -564,7 +596,7 @@ fastpath_drain(PyObject *self, PyObject *args)
     memset(msgs, 0, sizeof(struct mmsghdr) * (size_t)max_n);
     for (int i = 0; i < max_n; i++) {
         iovs[i].iov_base = bufs[i];
-        iovs[i].iov_len = FP_DGRAM_MAX;
+        iovs[i].iov_len = FASTIO_DGRAM_MAX;
         msgs[i].msg_hdr.msg_iov = &iovs[i];
         msgs[i].msg_hdr.msg_iovlen = 1;
         msgs[i].msg_hdr.msg_name = &addrs[i];
@@ -609,25 +641,10 @@ fastpath_drain(PyObject *self, PyObject *args)
             e = fp_find(c, key, keylen, (uint64_t)gen, t0);
         if (e == NULL) {
             /* miss: surface to Python exactly like recv_batch */
-            PyObject *payload = PyBytes_FromStringAndSize(
-                (const char *)pkt, (Py_ssize_t)plen);
-            PyObject *addr = payload
-                ? fastio_addr_to_tuple(&addrs[i]) : NULL;
-            if (payload == NULL || addr == NULL) {
-                Py_XDECREF(payload);
-                Py_XDECREF(addr);
+            if (surface_miss(misses, pkt, plen, &addrs[i]) < 0) {
                 Py_DECREF(misses);
                 return NULL;
             }
-            PyObject *item = PyTuple_Pack(2, payload, addr);
-            Py_DECREF(payload);
-            Py_DECREF(addr);
-            if (item == NULL || PyList_Append(misses, item) < 0) {
-                Py_XDECREF(item);
-                Py_DECREF(misses);
-                return NULL;
-            }
-            Py_DECREF(item);
             continue;
         }
 
@@ -641,20 +658,10 @@ fastpath_drain(PyObject *self, PyObject *args)
         if (wlen < 12 + qn_len + 4) {
             /* defensive: a cached response must embed the question */
             fp_entry_free(c, e);
-            PyObject *payload = PyBytes_FromStringAndSize(
-                (const char *)pkt, (Py_ssize_t)plen);
-            PyObject *addr = payload
-                ? fastio_addr_to_tuple(&addrs[i]) : NULL;
-            PyObject *item = (payload && addr)
-                ? PyTuple_Pack(2, payload, addr) : NULL;
-            Py_XDECREF(payload);
-            Py_XDECREF(addr);
-            if (item == NULL || PyList_Append(misses, item) < 0) {
-                Py_XDECREF(item);
+            if (surface_miss(misses, pkt, plen, &addrs[i]) < 0) {
                 Py_DECREF(misses);
                 return NULL;
             }
-            Py_DECREF(item);
             continue;
         }
         uint8_t *out = outs[n_hits];

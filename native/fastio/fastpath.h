@@ -10,8 +10,17 @@
 
 #include <sys/socket.h>
 
+#define FASTIO_BATCH 64
+#define FASTIO_DGRAM_MAX 65535
+
 /* fastio.c */
 PyObject *fastio_addr_to_tuple(const struct sockaddr_storage *ss);
+
+/* receive arena shared by recv_batch and fastpath_drain — only one of
+ * them runs at a time (both hold the GIL for the whole call), and a
+ * process uses one or the other per readiness event; sharing saves ~4MB
+ * RSS over two static copies */
+extern unsigned char fastio_shared_bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
 
 /* fastpath.c */
 PyObject *fastpath_new(PyObject *self, PyObject *args);

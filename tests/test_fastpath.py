@@ -202,6 +202,36 @@ class TestFastpathUnit:
                                    [response_wire(tag=b"NEW0")])
         assert fastio.fastpath_stats(cache)["entries"] == 1
 
+    def test_put_with_remaining_ttl_overrides_cache_expiry(self):
+        srv, cli, port = udp_pair()
+        cache = make_cache(expiry_ms=60000)
+        # an entry completed late in its Python-cache life carries only
+        # its remaining lifetime — not a fresh full window
+        fastio.fastpath_put(cache, ckey(), 1, 1, [response_wire()], 1)
+        time.sleep(0.02)
+        cli.sendto(query_pkt(), ("127.0.0.1", port))
+        misses, served = self.drain(cache, srv)
+        assert (len(misses), served) == (1, 0)
+
+    def test_qtype_stats_overflow_uses_catchall(self):
+        srv, cli, port = udp_pair()
+        cache = make_cache()
+        # 20 distinct qtypes: the first 15 get their own stats slot, the
+        # rest must fold into the 0xFFFF catch-all, never a real qtype
+        for qt in range(1, 21):
+            fastio.fastpath_put(cache, ckey(qtype=qt), qt, 1,
+                                [response_wire()])
+        for i, qt in enumerate(range(1, 21)):
+            cli.sendto(query_pkt(qid=0x3000 + i, qtype=qt),
+                       ("127.0.0.1", port))
+            misses, served = self.drain(cache, srv)
+            assert served == 1, qt
+            cli.recvfrom(4096)
+        per = fastio.fastpath_stats(cache)["per_qtype"]
+        assert all(per[qt]["count"] == 1 for qt in range(1, 16))
+        assert per[0xFFFF]["count"] == 5
+        assert not any(qt in per for qt in range(16, 21))
+
     def test_stats_shape(self):
         srv, cli, port = udp_pair()
         cache = make_cache()

@@ -1,0 +1,142 @@
+"""Store-layer observability: Gauge collector + mirror/ZK-client metrics.
+
+The reference gets its store-client metrics by passing the shared artedi
+collector into zkstream (``lib/zk.js:26-38``); these tests pin the
+rebuild's equivalents — mirror watch/rebuild counters, structural gauges
+sampled at scrape time, and the ZooKeeper client's session/request
+counters over the real wire protocol.
+"""
+import asyncio
+
+from binder_tpu.metrics.collector import Gauge, MetricsCollector
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+
+
+class TestGauge:
+    def test_set_and_expose(self):
+        g = Gauge("g_test", "help text")
+        g.set(3.5)
+        g.set(7, labels={"kind": "b"})
+        text = g.expose()
+        assert "# TYPE g_test gauge" in text
+        assert "g_test 3.5" in text
+        assert 'g_test{kind="b"} 7' in text
+
+    def test_function_sampled_at_scrape(self):
+        vals = [1]
+        g = Gauge("g_fn", "")
+        g.set_function(lambda: vals[0])
+        assert "g_fn 1" in g.expose()
+        vals[0] = 42
+        assert "g_fn 42" in g.expose()
+        assert g.value() == 42.0
+
+    def test_bad_sampler_does_not_break_scrape(self):
+        g = Gauge("g_bad", "")
+        g.set(5, labels={"ok": "y"})
+        g.set_function(lambda: 1 / 0, labels={"ok": "n"})
+        text = g.expose()
+        assert 'g_bad{ok="y"} 5' in text
+        assert '{ok="n"}' not in text
+
+    def test_collector_registry(self):
+        c = MetricsCollector()
+        g = c.gauge("g_reg", "h")
+        assert c.gauge("g_reg") is g
+        g.set(1)
+        assert "g_reg 1" in c.expose()
+
+
+def mirror_with_collector():
+    collector = MetricsCollector()
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN, collector=collector)
+    return store, cache, collector
+
+
+class TestMirrorMetrics:
+    def test_counters_and_gauges_track_mutations(self):
+        store, cache, collector = mirror_with_collector()
+        store.put_json("/com/foo/web",
+                       {"type": "host", "host": {"address": "10.0.0.1"}})
+        store.start_session()
+
+        text = collector.expose()
+        assert "binder_store_session_rebuilds 1" in text
+        # root foo.com + web.foo.com
+        assert collector.get("binder_store_mirrored_nodes").value() == 2
+        assert "binder_store_reverse_entries 1" in text
+        assert "binder_store_ready 1" in text
+        assert 'binder_store_watch_events{kind="children"}' in text
+        assert 'binder_store_watch_events{kind="data"}' in text
+
+        events_before = collector.get(
+            "binder_store_watch_events").value({"kind": "data"})
+        store.put_json("/com/foo/web",
+                       {"type": "host", "host": {"address": "10.0.0.2"}})
+        assert collector.get("binder_store_watch_events").value(
+            {"kind": "data"}) > events_before
+
+    def test_parse_failure_counter(self):
+        store, cache, collector = mirror_with_collector()
+        store.start_session()
+        store.mkdirp("/com/foo/bad", b"{not json")
+        assert collector.get(
+            "binder_store_node_parse_failures").value() == 1
+
+    def test_not_ready_gauge_before_session(self):
+        _, cache, collector = mirror_with_collector()
+        assert "binder_store_ready 0" in collector.expose()
+
+    def test_bare_cache_needs_no_collector(self):
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        store.put_json("/com/foo/web",
+                       {"type": "host", "host": {"address": "10.0.0.1"}})
+        store.start_session()
+        assert cache.is_ready()
+
+
+class TestZKClientMetrics:
+    def test_session_and_request_counters(self):
+        from binder_tpu.store.zk_client import ZKClient
+        from binder_tpu.store.zk_testserver import ZKTestServer
+
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            collector = MetricsCollector()
+            client = ZKClient(address="127.0.0.1", port=server.port,
+                              session_timeout_ms=2000,
+                              collector=collector)
+            cache = MirrorCache(client, DOMAIN, collector=collector)
+            client.start()
+            try:
+                deadline = asyncio.get_running_loop().time() + 5
+                while (asyncio.get_running_loop().time() < deadline
+                       and not client.is_connected()):
+                    await asyncio.sleep(0.02)
+                assert client.is_connected()
+                import json as _json
+                await client.mkdirp(
+                    "/com/foo/web",
+                    _json.dumps({"type": "host",
+                                 "host": {"address": "10.1.1.1"}}).encode())
+                deadline = asyncio.get_running_loop().time() + 5
+                while (asyncio.get_running_loop().time() < deadline
+                       and cache.lookup("web.foo.com") is None):
+                    await asyncio.sleep(0.02)
+                assert cache.lookup("web.foo.com") is not None
+
+                text = collector.expose()
+                assert "binder_zk_sessions_established 1" in text
+                assert "binder_zk_connected 1" in text
+                assert collector.get("binder_zk_requests").value() > 0
+                assert collector.get(
+                    "binder_zk_watch_notifications").value() > 0
+            finally:
+                client.close()
+                await server.stop()
+        asyncio.run(run())
