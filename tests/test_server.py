@@ -254,6 +254,28 @@ class TestMetrics:
         assert 'binder_requests_completed{type="A"} 2' in exposed
         assert "binder_request_latency_seconds_bucket" in exposed
 
+    def test_slow_query_promotes_log_to_warn(self, monkeypatch, caplog):
+        """Latency > SLOW_QUERY_MS logs at warn even with the per-query
+        log off (reference lib/server.js:511-514)."""
+        import logging as _logging
+
+        import binder_tpu.server as srv_mod
+
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, query_log=False)
+            monkeypatch.setattr(srv_mod, "SLOW_QUERY_MS", -1.0)
+            with caplog.at_level(_logging.INFO, logger="binder.server"):
+                await udp_ask(server.udp_port, "web.foo.com", Type.A)
+                await asyncio.sleep(0)
+            await server.stop()
+
+        asyncio.run(run())
+        warns = [r for r in caplog.records
+                 if r.levelno == _logging.WARNING and "DNS query" in
+                 r.getMessage()]
+        assert warns, [r.getMessage() for r in caplog.records]
+
 
 class TestReviewRegressions:
     """Regressions from the second code-review pass."""
