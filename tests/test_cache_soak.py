@@ -1,0 +1,172 @@
+"""Randomized churn soak for the mirror cache (SURVEY §7.3 hard part #1).
+
+The reference's watch-tree diff logic is the piece the survey flags as
+"must not leak watchers or serve stale reverse entries across session
+resets" — and the piece the reference never tests.  This soak drives a
+seeded random mix of creates/updates/deletes/subtree-removals/session
+expiries against the fake store and, at checkpoints, asserts full
+bidirectional consistency:
+
+- every store node under the domain subtree is mirrored with its data;
+- every mirrored node still exists in the store (no ghosts);
+- the reverse (PTR) index is *exactly* the set of live host-type nodes
+  with addresses (no stale entries, no misses);
+- no watcher accumulates duplicate listeners (leak check);
+- the mutation generation only moves forward.
+"""
+import json
+import random
+
+from binder_tpu.store import FakeStore, MirrorCache, domain_to_path
+
+DOMAIN = "foo.com"
+ROOT = "/com/foo"
+
+HOST_TYPES = ["host", "db_host", "load_balancer", "rr_host"]
+
+
+def record_for(rng, kind):
+    if kind == "service":
+        return {"type": "service",
+                "service": {"srvce": "_s", "proto": "_tcp",
+                            "port": rng.randrange(1, 65536)}}
+    t = rng.choice(HOST_TYPES)
+    return {"type": t,
+            t: {"address": f"10.{rng.randrange(256)}.{rng.randrange(256)}"
+                           f".{rng.randrange(1, 255)}"}}
+
+
+def store_tree(store, path=ROOT):
+    """(path -> data bytes) for the whole live subtree."""
+    out = {}
+    kids = store.get_children(path)
+    if kids is None:
+        return out
+    out[path] = store.get_data(path)
+    for kid in kids:
+        out.update(store_tree(store, f"{path}/{kid}"))
+    return out
+
+
+def path_to_domain(path):
+    assert path.startswith("/")
+    return ".".join(reversed(path[1:].split("/")))
+
+
+def assert_consistent(store, cache):
+    tree = store_tree(store)
+
+    # store -> mirror: every live node is mirrored with current data
+    for path, raw in tree.items():
+        domain = path_to_domain(path)
+        node = cache.lookup(domain)
+        assert node is not None, f"store node {path} not mirrored"
+        expect = json.loads(raw.decode()) if raw else None
+        # unparseable/scalar data keeps the previous value by design;
+        # this soak only writes valid JSON objects, so expect equality
+        assert node.data == expect, f"stale data at {path}"
+
+    # mirror -> store: no ghost nodes
+    live_domains = {path_to_domain(p) for p in tree}
+    for domain in cache.nodes:
+        assert domain in live_domains, f"ghost mirror node {domain}"
+
+    # reverse index == exactly the live host-typed nodes
+    expected_rev = {}
+    for path, raw in tree.items():
+        rec = json.loads(raw.decode()) if raw else None
+        if not isinstance(rec, dict):
+            continue
+        rtype = rec.get("type")
+        sub = rec.get(rtype) if isinstance(rtype, str) else None
+        if rtype in {"db_host", "host", "load_balancer", "moray_host",
+                     "redis_host", "ops_host", "rr_host"} \
+                and isinstance(sub, dict) and sub.get("address"):
+            # last writer wins on address collisions, matching the map
+            expected_rev[sub["address"]] = path_to_domain(path)
+    for ip, node in cache.rev_lookup.items():
+        assert ip in expected_rev, f"stale reverse entry {ip}"
+        assert node.domain in live_domains
+    for ip in expected_rev:
+        # collisions allowed: some live node owns the IP
+        assert ip in cache.rev_lookup, f"missing reverse entry {ip}"
+
+    # watcher-leak check: at most one listener per event per path
+    for path, w in store._watchers.items():
+        for event, listeners in w._listeners.items():
+            assert len(listeners) <= 1, \
+                f"{len(listeners)} {event} listeners leaked on {path}"
+
+
+def test_churn_soak():
+    rng = random.Random(0xB1DE2)
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.start_session()
+
+    live_paths = []
+    last_gen = cache.gen
+
+    def new_path():
+        # up to 3 levels below the root; parents auto-created by mkdirp
+        depth = rng.randrange(1, 4)
+        labels = [f"n{rng.randrange(30)}" for _ in range(depth)]
+        return ROOT + "/" + "/".join(labels)
+
+    for step in range(600):
+        op = rng.random()
+        if op < 0.45 or not live_paths:
+            path = new_path()
+            store.put_json(path, record_for(rng, rng.choice(
+                ["service", "host"])))
+            # mkdirp may have created intermediate nodes too
+            p = path
+            while p != ROOT:
+                if p not in live_paths:
+                    live_paths.append(p)
+                p = p.rsplit("/", 1)[0]
+        elif op < 0.70:
+            path = rng.choice(live_paths)
+            store.put_json(path, record_for(rng, rng.choice(
+                ["service", "host"])))
+        elif op < 0.85:
+            path = rng.choice(live_paths)
+            store.rmr(path)
+            live_paths = [p for p in live_paths
+                          if p != path and not p.startswith(path + "/")]
+        elif op < 0.95:
+            store.expire_session()
+        else:
+            # delete a leaf specifically (exercises the non-recursive path)
+            leaves = [p for p in live_paths
+                      if not any(q.startswith(p + "/") for q in live_paths)]
+            if leaves:
+                path = rng.choice(leaves)
+                store.delete(path)
+                live_paths.remove(path)
+
+        assert cache.gen >= last_gen, "generation went backwards"
+        last_gen = cache.gen
+
+        if step % 50 == 49:
+            assert_consistent(store, cache)
+
+    assert_consistent(store, cache)
+    # the root itself must have survived all of it
+    assert cache.is_ready()
+
+
+def test_churn_soak_with_sessions_only():
+    """Pure session-churn: expire repeatedly over a static tree and
+    confirm listeners/reverse entries stay exact (regression shape for
+    the 2^depth rebind and listener-leak hazards)."""
+    rng = random.Random(7)
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.start_session()
+    for i in range(12):
+        store.put_json(f"{ROOT}/svc{i % 4}/h{i}",
+                       record_for(rng, "host"))
+    for _ in range(25):
+        store.expire_session()
+        assert_consistent(store, cache)
