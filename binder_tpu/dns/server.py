@@ -51,6 +51,16 @@ def pack_balancer_frame(family: int, addr: str, port: int,
                        port) + payload
 
 
+def pack_gen_frame(gen: int) -> bytes:
+    """Control frame reporting the mirror-cache generation to the
+    balancer (family 0 marks control; the transport byte is the opcode,
+    0 = generation report; the 16-byte address field carries the
+    generation, big-endian, in its first 8 bytes).  The balancer uses it
+    to invalidate its answer cache (docs/balancer-protocol.md)."""
+    return struct.pack(">IBBB16sH", BALANCER_HDR, BALANCER_VERSION, 0, 0,
+                       (gen & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"), 0)
+
+
 def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
     version, family, transport, raw, port = struct.unpack_from(
         ">BBB16sH", frame, 0)
@@ -92,6 +102,14 @@ class DnsServer:
         self.fastpath = None
         self.fastpath_gen: Optional[Callable[[], int]] = None
         self.fastpath_gate: Optional[Callable[[], bool]] = None
+        # Balancer answer-cache support: generation frames let the
+        # balancer cache responses with backend-driven invalidation.
+        # `gen_source` supplies the current generation; notify_mutation
+        # (wired to MirrorCache.on_mutation) broadcasts it, coalesced to
+        # one frame per event-loop turn.
+        self.gen_source: Optional[Callable[[], int]] = None
+        self._balancer_writers: dict = {}   # writer -> per-conn write lock
+        self._gen_dirty = False
 
     # -- shared query dispatch --
     #
@@ -410,10 +428,54 @@ class DnsServer:
         self._unix_servers.append(server)
         self.log.info("balancer service started on %s", path)
 
+    def notify_mutation(self) -> None:
+        """Broadcast a fresh generation frame to every balancer link,
+        coalesced to one frame per event-loop turn (a session rebuild
+        bumps the generation once per mirrored node)."""
+        if self._gen_dirty or not self._balancer_writers \
+                or self.gen_source is None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return   # no loop: no balancer link is being served either
+        self._gen_dirty = True
+        loop.call_soon(self._send_gen_frames)
+
+    def _send_gen_frames(self) -> None:
+        self._gen_dirty = False
+        if self.gen_source is None:
+            return
+        frame = pack_gen_frame(self.gen_source())
+        for writer, lock in list(self._balancer_writers.items()):
+            # the frame must go through the same ordered write path as
+            # responses: a bare write could overtake a response computed
+            # under the OLD generation that is still parked behind the
+            # lock, and the balancer would tag that stale response with
+            # the new generation.  Task-creation order is the causal
+            # order (the stale response's task exists before the
+            # mutation ran), and asyncio's FIFO scheduling + FIFO lock
+            # waiters preserve it.
+            async def _write(w=writer, lk=lock):
+                try:
+                    async with lk:
+                        w.write(frame)
+                        await w.drain()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass   # link died; the reader side cleans up
+            task = asyncio.ensure_future(_write())
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
     async def _balancer_conn(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter) -> None:
         lock = asyncio.Lock()
         self._conns.add(writer)
+        if self.gen_source is not None:
+            # report our generation immediately so the balancer can cache
+            # from the first response
+            writer.write(pack_gen_frame(self.gen_source()))
+            self._balancer_writers[writer] = lock
         try:
             while True:
                 hdr = await reader.readexactly(4)
@@ -453,6 +515,7 @@ class DnsServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._balancer_writers.pop(writer, None)
             self._conns.discard(writer)
             writer.close()
             try:

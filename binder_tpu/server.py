@@ -116,6 +116,13 @@ class BinderServer:
         # truth — completed answer-cache entries are pushed down in
         # _on_query, and the C-side counters fold into the same
         # Prometheus collectors at scrape time (_fold_fastpath_metrics).
+        # Balancer answer-cache support: report our mirror generation
+        # over balancer links so the balancer can cache responses with
+        # correct invalidation (docs/balancer-protocol.md control frames)
+        self.engine.gen_source = lambda: self.zk_cache.gen
+        if hasattr(zk_cache, "on_mutation"):
+            zk_cache.on_mutation(self.engine.notify_mutation)
+
         self._fastpath = None
         self._fp_folded: dict = {}
         self._fp_fold_lock = threading.Lock()

@@ -74,7 +74,7 @@ class TreeNode:
     # -- watch event handlers --
 
     def on_children_changed(self, kids: List[str]) -> None:
-        self.cache.gen += 1
+        self.cache.bump_gen()
         if self.cache.m_watch_children is not None:
             self.cache.m_watch_children.inc()
         new_kids: Dict[str, TreeNode] = {}
@@ -91,7 +91,7 @@ class TreeNode:
         self.kids = new_kids
 
     def on_data_changed(self, data: bytes) -> None:
-        self.cache.gen += 1
+        self.cache.bump_gen()
         if self.cache.m_watch_data is not None:
             self.cache.m_watch_data.inc()
         try:
@@ -153,7 +153,7 @@ class TreeNode:
                 kid.rebind()
 
     def unbind(self) -> None:
-        self.cache.gen += 1
+        self.cache.bump_gen()
         self.log.debug("unbinding node at %s", self.path)
         if self.watcher is not None:
             self.watcher.clear()
@@ -179,6 +179,9 @@ class MirrorCache:
         # generation counter: bumped on every mirrored mutation so answer
         # caches layered above can invalidate without scanning
         self.gen = 0
+        # mutation subscribers (e.g. the balancer generation broadcast);
+        # called synchronously on every bump — keep them cheap
+        self._mutation_cbs: List = []
         # store-mirror observability (the reference gets the analogous
         # client metrics by passing its artedi collector into zkstream,
         # lib/zk.js:26-38); all optional — tests build bare caches
@@ -215,6 +218,18 @@ class MirrorCache:
                 "1 when the mirror has a live session and root node"
             ).set_function(lambda: 1.0 if self.is_ready() else 0.0)
         store.on_session(self.rebuild)
+
+    def on_mutation(self, cb) -> None:
+        """Subscribe to generation bumps (any mirrored store mutation)."""
+        self._mutation_cbs.append(cb)
+
+    def bump_gen(self) -> None:
+        self.gen += 1
+        for cb in self._mutation_cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a subscriber bug must not
+                self.log.exception("mutation callback failed")  # stop serving
 
     def is_ready(self) -> bool:
         return self.domain in self.nodes

@@ -39,6 +39,7 @@
 #include <sys/stat.h>
 #include <sys/timerfd.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -48,6 +49,8 @@
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+#include "../common/dnskey.h"
 
 namespace {
 
@@ -155,26 +158,52 @@ struct Stream {
 
     void queue_write(std::vector<uint8_t> &&data) { wq.push_back(std::move(data)); }
 
-    /* returns false on fatal error */
+    /* Drain the queue with writev — under load many query frames are
+     * queued per event-loop pass (see flush_pending_backends), and one
+     * gathered write moves them all in a single syscall instead of one
+     * write per frame.  Returns false on fatal error. */
     bool flush() {
         while (!wq.empty()) {
-            const auto &front = wq.front();
-            ssize_t n = write(fd, front.data() + wq_off,
-                              front.size() - wq_off);
+            struct iovec iov[64];
+            int cnt = 0;
+            for (auto it = wq.begin(); it != wq.end() && cnt < 64;
+                 ++it, ++cnt) {
+                size_t skip = (cnt == 0) ? wq_off : 0;
+                iov[cnt].iov_base = (void *)(it->data() + skip);
+                iov[cnt].iov_len = it->size() - skip;
+            }
+            ssize_t n = writev(fd, iov, cnt);
             if (n < 0) {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+                if (errno == EINTR) continue;
                 return false;
             }
-            wq_off += (size_t)n;
-            if (wq_off == front.size()) {
-                wq.pop_front();
-                wq_off = 0;
+            size_t left = (size_t)n;
+            while (left > 0) {
+                size_t avail = wq.front().size() - wq_off;
+                if (left >= avail) {
+                    left -= avail;
+                    wq.pop_front();
+                    wq_off = 0;
+                } else {
+                    wq_off += left;
+                    left = 0;
+                }
             }
         }
         return true;
     }
     bool want_write() const { return !wq.empty(); }
 };
+
+struct CacheEntry {
+    double expire_at = 0;
+    std::vector<uint8_t> wire;
+};
+uint64_t g_cache_bytes = 0;           /* across all backends */
+constexpr size_t kMaxCacheEntriesPerBackend = 65536;
+constexpr uint64_t kMaxCacheBytes = 64ull << 20;
+constexpr size_t kMaxCacheWire = 4096;
 
 /* ---- backend (one binder process behind a UNIX socket) ---- */
 struct Backend {
@@ -186,6 +215,21 @@ struct Backend {
     uint64_t forwarded = 0;
     uint64_t responded = 0;
     uint64_t connect_failures = 0;
+    /* deferred-flush state (see flush_pending_backends) */
+    bool flush_pending = false;
+    size_t pending_queued = 0;
+    /* answer-cache invalidation state: the backend reports its mirror
+     * generation over the socket (control frames); entries resolved
+     * under an older generation are stale.  epoch distinguishes
+     * reconnects — a restarted backend's generation counter restarts,
+     * so entries from the previous process must never match. */
+    uint64_t gen = 0;
+    bool gen_known = false;
+    uint32_t epoch = 0;
+    /* per-backend answer cache (see backend_cache_clear for the
+     * invalidation invariant) */
+    std::unordered_map<std::string, CacheEntry> cache;
+    uint64_t cache_bytes = 0;
 };
 
 /* ---- TCP client connection state ---- */
@@ -199,6 +243,7 @@ struct Balancer {
     std::string bind_addr = "0.0.0.0";
     int port = 53;
     int scan_ms = 2000;
+    int cache_ms = 60000;      /* answer-cache expiry; 0 disables */
 
     int epfd = -1;
     int udp_fd = -1;
@@ -215,6 +260,7 @@ struct Balancer {
     int rr_next = 0;
 
     uint64_t udp_queries = 0, tcp_queries = 0, drops = 0;
+    uint64_t cache_hits = 0;
     uint64_t started_at = 0;
 };
 
@@ -257,6 +303,8 @@ uint64_t tag(Kind kind, int fd) { return ((uint64_t)kind << 32) | (uint32_t)fd; 
 
 /* ---------------- backend management ---------------- */
 
+void backend_cache_clear(Backend &be);   /* defined with the cache below */
+
 void backend_mark_down(Backend &be) {
     if (be.conn.fd >= 0) {
         g_bal.backend_by_fd.erase(be.conn.fd);
@@ -264,6 +312,8 @@ void backend_mark_down(Backend &be) {
         be.conn = Stream();
     }
     be.healthy = false;
+    be.gen_known = false;
+    backend_cache_clear(be);   /* a restarted process restarts its gen */
 }
 
 bool backend_connect(Backend &be) {
@@ -281,6 +331,10 @@ bool backend_connect(Backend &be) {
     be.conn = Stream();
     be.conn.fd = fd;
     be.healthy = true;   /* optimistic; demoted on first error */
+    /* new process behind the same socket path: its generation counter
+     * restarts, so retire every cache entry from the previous epoch */
+    be.epoch++;
+    be.gen_known = false;
     g_bal.backend_by_fd[fd] = be.id;
     epoll_add(fd, EPOLLIN, tag(KIND_BACKEND, fd));
     tracemsg("backend %d connected at %s", be.id, be.path.c_str());
@@ -375,6 +429,101 @@ std::vector<uint8_t> make_frame(const ClientKey &k, uint8_t transport,
     return out;
 }
 
+/* ---------------- answer cache ----------------
+ *
+ * The balancer caches single-answer UDP responses it forwards, keyed by
+ * (backend id, backend epoch, question key) — the question key is the
+ * same dnskey_build the backend fast path uses.  Correctness mirrors
+ * the backend's own answer cache:
+ *  - entries record the backend's mirror generation at fill time;
+ *    backends report it over the socket (control frames, sent on
+ *    connect and on every store mutation), and stale-generation
+ *    entries are lazily dropped;
+ *  - a reconnect bumps the epoch, retiring all prior entries (a
+ *    restarted backend's generation counter restarts);
+ *  - time expiry (-c <ms>, default 60 s, 0 disables);
+ *  - multi-answer responses are never cached, so round-robin rotation
+ *    still happens in the backends;
+ *  - SERVFAIL is never cached (matches BinderServer._on_query).
+ * Fill state rides a fixed pending table keyed by (client, qid): the
+ * forward records the question key, the matching response harvests it.
+ */
+struct PendingFill {
+    ClientKey client{};
+    uint16_t qid = 0;
+    uint16_t keylen = 0;
+    int backend_id = -1;
+    uint32_t epoch = 0;
+    bool used = false;
+    uint8_t key[DNSKEY_MAX];
+};
+constexpr size_t kPendingSlots = 8192;   /* power of two */
+PendingFill g_pending_fill[kPendingSlots];
+
+size_t pending_slot(const ClientKey &k, uint16_t qid) {
+    size_t h = ClientKeyHash{}(k);
+    h ^= (size_t)qid * 1099511628211ULL;
+    return h & (kPendingSlots - 1);
+}
+
+double mono_s() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+/* Every entry in a backend's cache was filled under its *current*
+ * generation and connection epoch — a generation report that advances
+ * the generation, and every reconnect, clears the whole per-backend
+ * map.  That keeps invalidation O(changed backend), reclaims dead
+ * entries immediately (no unreachable-key garbage), and removes any
+ * need for per-entry generation checks on the hit path. */
+void backend_cache_clear(Backend &be) {
+    g_cache_bytes -= be.cache_bytes;
+    be.cache_bytes = 0;
+    be.cache.clear();
+}
+
+void backend_cache_insert(Backend &be, const uint8_t *key, size_t keylen,
+                          const uint8_t *wire, size_t len) {
+    if (be.cache.size() >= kMaxCacheEntriesPerBackend ||
+        g_cache_bytes + len > kMaxCacheBytes) {
+        /* bounded reset, like the affinity table: the cache is an
+         * optimization, and a flood of distinct questions must not OOM */
+        backend_cache_clear(be);
+    }
+    std::string mkey((const char *)key, keylen);
+    auto it = be.cache.find(mkey);
+    if (it != be.cache.end()) {
+        g_cache_bytes -= it->second.wire.size();
+        be.cache_bytes -= it->second.wire.size();
+        be.cache.erase(it);
+    }
+    CacheEntry e;
+    e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
+    e.wire.assign(wire, wire + len);
+    g_cache_bytes += len;
+    be.cache_bytes += len;
+    be.cache.emplace(std::move(mkey), std::move(e));
+}
+
+/* Backends with frames queued this event-loop pass; flushed once per
+ * pass (flush_pending_backends) so a burst of N queries to a backend
+ * costs one writev, not N writes. */
+std::vector<int> g_flush_pending;
+
+void forward_query_to(int idx, const ClientKey &client, uint8_t transport,
+                      const uint8_t *payload, size_t len) {
+    Backend &be = g_bal.backends[idx];
+    be.conn.queue_write(make_frame(client, transport, payload, len));
+    be.forwarded++;
+    be.pending_queued++;
+    if (!be.flush_pending) {
+        be.flush_pending = true;
+        g_flush_pending.push_back(idx);
+    }
+}
+
 void forward_query(const ClientKey &client, uint8_t transport,
                    const uint8_t *payload, size_t len) {
     int idx = pick_backend(client);
@@ -383,37 +532,185 @@ void forward_query(const ClientKey &client, uint8_t transport,
         tracemsg("no healthy backend, dropping query");
         return;
     }
-    Backend &be = g_bal.backends[idx];
-    be.conn.queue_write(make_frame(client, transport, payload, len));
-    be.forwarded++;
-    if (!be.conn.flush()) {
-        logmsg("backend %d write error: %s", be.id, strerror(errno));
-        backend_mark_down(be);
-        g_bal.drops++;
-        return;
+    forward_query_to(idx, client, transport, payload, len);
+}
+
+void flush_pending_backends() {
+    for (int idx : g_flush_pending) {
+        Backend &be = g_bal.backends[idx];
+        be.flush_pending = false;
+        size_t queued = be.pending_queued;
+        be.pending_queued = 0;
+        if (be.conn.fd < 0) {
+            /* went down earlier in this pass; its write queue (and the
+             * frames just queued) died with the connection */
+            g_bal.drops += queued;
+            continue;
+        }
+        if (!be.conn.flush()) {
+            logmsg("backend %d write error: %s", be.id, strerror(errno));
+            backend_mark_down(be);
+            g_bal.drops += queued;
+            continue;
+        }
+        if (be.conn.want_write())
+            epoll_mod(be.conn.fd, EPOLLIN | EPOLLOUT,
+                      tag(KIND_BACKEND, be.conn.fd));
     }
-    if (be.conn.want_write())
-        epoll_mod(be.conn.fd, EPOLLIN | EPOLLOUT, tag(KIND_BACKEND, be.conn.fd));
+    g_flush_pending.clear();
+}
+
+/* UDP egress batch: responses decoded from one backend-read pass are
+ * flushed with a single sendmmsg.  Payload pointers reference the
+ * backend's read buffer, so the batch MUST be flushed before that
+ * buffer is mutated (handle_backend flushes after each framing pass).
+ * Per-destination errors skip one datagram and continue — one
+ * unreachable client must not drop other clients' responses. */
+struct UdpOut {
+    struct mmsghdr msgs[64];
+    struct iovec iovs[64];
+    struct sockaddr_storage addrs[64];
+    /* copy arena for cache-hit responses (they need id/question patching
+     * and must outlive the cache entry until the flush) */
+    uint8_t copybuf[64][kMaxCacheWire];
+    int n = 0;
+} g_udp_out;
+
+void udp_out_flush() {
+    int off = 0;
+    while (off < g_udp_out.n) {
+        int sent = sendmmsg(g_bal.udp_fd, g_udp_out.msgs + off,
+                            (unsigned)(g_udp_out.n - off), MSG_DONTWAIT);
+        if (sent >= 0) {
+            off += sent > 0 ? sent : 1;
+            continue;
+        }
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+            break;             /* socket buffer full: drop rest (UDP) */
+        off += 1;              /* per-destination failure: skip one */
+    }
+    g_udp_out.n = 0;
+}
+
+void udp_out_add(const struct sockaddr_storage &ss, socklen_t slen,
+                 const uint8_t *payload, size_t len) {
+    if (g_udp_out.n == 64)
+        udp_out_flush();
+    int i = g_udp_out.n++;
+    g_udp_out.addrs[i] = ss;
+    g_udp_out.iovs[i].iov_base = (void *)payload;
+    g_udp_out.iovs[i].iov_len = len;
+    memset(&g_udp_out.msgs[i], 0, sizeof(g_udp_out.msgs[i]));
+    g_udp_out.msgs[i].msg_hdr.msg_iov = &g_udp_out.iovs[i];
+    g_udp_out.msgs[i].msg_hdr.msg_iovlen = 1;
+    g_udp_out.msgs[i].msg_hdr.msg_name = &g_udp_out.addrs[i];
+    g_udp_out.msgs[i].msg_hdr.msg_namelen = slen;
+}
+
+/* Like udp_out_add, but copies the payload into the batch's own arena
+ * and returns the copy for in-place patching (cache-hit responses). */
+uint8_t *udp_out_add_copy(const struct sockaddr_storage &ss,
+                          socklen_t slen, const uint8_t *payload,
+                          size_t len) {
+    if (g_udp_out.n == 64)
+        udp_out_flush();
+    uint8_t *dst = g_udp_out.copybuf[g_udp_out.n];
+    memcpy(dst, payload, len);
+    udp_out_add(ss, slen, dst, len);
+    return dst;
 }
 
 /* ---------------- fronts ---------------- */
 
 void handle_udp() {
-    uint8_t buf[kMaxUdpPacket];
+    /* recvmmsg drain: up to 64 datagrams per kernel crossing (the same
+     * batching the backend datapath uses, native/fastio/fastio.c) */
+    static uint8_t bufs[64][kMaxUdpPacket];
+    struct mmsghdr msgs[64];
+    struct iovec iovs[64];
+    struct sockaddr_storage addrs[64];
+
     for (;;) {
-        struct sockaddr_storage ss{};
-        socklen_t slen = sizeof(ss);
-        ssize_t n = recvfrom(g_bal.udp_fd, buf, sizeof(buf), 0,
-                             (struct sockaddr *)&ss, &slen);
-        if (n < 0) {
-            if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-            logmsg("udp recv error: %s", strerror(errno));
-            return;
+        memset(msgs, 0, sizeof(msgs));
+        for (int i = 0; i < 64; i++) {
+            iovs[i].iov_base = bufs[i];
+            iovs[i].iov_len = kMaxUdpPacket;
+            msgs[i].msg_hdr.msg_iov = &iovs[i];
+            msgs[i].msg_hdr.msg_iovlen = 1;
+            msgs[i].msg_hdr.msg_name = &addrs[i];
+            msgs[i].msg_hdr.msg_namelen = sizeof(addrs[i]);
         }
-        if (n < 12) continue;      /* shorter than a DNS header */
-        g_bal.udp_queries++;
-        forward_query(key_from_sockaddr(ss), kTransportUdp, buf, (size_t)n);
+        int n = recvmmsg(g_bal.udp_fd, msgs, 64, MSG_DONTWAIT, nullptr);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+                break;
+            logmsg("udp recv error: %s", strerror(errno));
+            break;
+        }
+        for (int i = 0; i < n; i++) {
+            size_t plen = msgs[i].msg_len;
+            const uint8_t *pkt = bufs[i];
+            if (plen < 12) continue;             /* short of a header */
+            g_bal.udp_queries++;
+            ClientKey ck = key_from_sockaddr(addrs[i]);
+
+            if (g_bal.cache_ms > 0) {
+                uint8_t key[DNSKEY_MAX];
+                size_t qn_len = 0;
+                uint16_t qtype = 0;
+                size_t keylen = dnskey_build(pkt, plen, key, &qn_len,
+                                             &qtype);
+                if (keylen != 0) {
+                    int idx = pick_backend(ck);
+                    if (idx < 0) {
+                        g_bal.drops++;
+                        continue;
+                    }
+                    Backend &be = g_bal.backends[idx];
+                    /* reused buffer: no per-packet allocation on the
+                     * lookup path once its capacity has grown */
+                    static std::string lookup_key;
+                    lookup_key.assign((const char *)key, keylen);
+                    auto it = be.cache.find(lookup_key);
+                    if (it != be.cache.end()) {
+                        CacheEntry &e = it->second;
+                        if (mono_s() <= e.expire_at
+                                && e.wire.size() >= 12 + qn_len + 4) {
+                            uint8_t *out = udp_out_add_copy(
+                                addrs[i], msgs[i].msg_hdr.msg_namelen,
+                                e.wire.data(), e.wire.size());
+                            out[0] = pkt[0];        /* request id */
+                            out[1] = pkt[1];
+                            /* 0x20 case echo */
+                            memcpy(out + 12, pkt + 12, qn_len + 4);
+                            g_bal.cache_hits++;
+                            continue;
+                        }
+                        g_cache_bytes -= e.wire.size();
+                        be.cache_bytes -= e.wire.size();
+                        be.cache.erase(it);   /* expired */
+                    }
+                    /* miss: remember the key so the response can fill */
+                    PendingFill &pf = g_pending_fill[
+                        pending_slot(ck, dnskey_rd16(pkt))];
+                    pf.client = ck;
+                    pf.qid = dnskey_rd16(pkt);
+                    pf.keylen = (uint16_t)keylen;
+                    pf.backend_id = be.id;
+                    pf.epoch = be.epoch;
+                    pf.used = true;
+                    memcpy(pf.key, key, keylen);
+                    forward_query_to(idx, ck, kTransportUdp, pkt, plen);
+                    continue;
+                }
+            }
+            forward_query(ck, kTransportUdp, pkt, plen);
+        }
+        if (n < 64) break;
     }
+    flush_pending_backends();
+    udp_out_flush();
 }
 
 void tcp_client_close(int fd) {
@@ -465,10 +762,12 @@ void handle_tcp_client(int fd, uint32_t events) {
         ssize_t n = read(fd, buf, sizeof(buf));
         if (n < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            flush_pending_backends();
             tcp_client_close(fd);
             return;
         }
         if (n == 0) {
+            flush_pending_backends();
             tcp_client_close(fd);
             return;
         }
@@ -485,13 +784,82 @@ void handle_tcp_client(int fd, uint32_t events) {
         }
         if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
         if (rb.size() > kMaxFrame) {  /* garbage flood */
+            flush_pending_backends();
             tcp_client_close(fd);
             return;
         }
     }
+    flush_pending_backends();
 }
 
 /* ---------------- backend responses ---------------- */
+
+/* Harvest a forwarded response into the answer cache when its pending
+ * record matches (see the miss path in handle_udp).  Only single-answer
+ * (rotation lives in the backends), non-SERVFAIL UDP responses under a
+ * known backend generation are cacheable. */
+/* The pending record alone is NOT proof the response answers the
+ * recorded question: (client, qid) collide whenever a client has two
+ * queries in flight under one qid (routine for stub resolvers), and a
+ * slot overwrite would otherwise cache answer A under question B's key.
+ * So the response's own echoed question must byte-match the key. */
+bool response_matches_key(const PendingFill &pf, const uint8_t *payload,
+                          size_t len) {
+    uint16_t flags = dnskey_rd16(payload + 2);
+    if (!(flags & 0x8000))                       /* not a response */
+        return false;
+    if (flags & 0x0200)                          /* truncated: payload- */
+        return false;                            /* ceiling dependent */
+    if (((flags >> 8) & 1) != (pf.key[0] & 1))   /* RD echo */
+        return false;
+    if (dnskey_rd16(payload + 4) != 1)           /* qdcount */
+        return false;
+    unsigned ceiling = ((unsigned)pf.key[1] << 8) | pf.key[2];
+    if (len > ceiling)
+        return false;
+    /* question name: uncompressed labels, lowercased compare against
+     * the key's qname (key layout: 7 fixed bytes + qname) */
+    size_t off = 12;
+    size_t klen = (size_t)pf.keylen - 7;
+    const uint8_t *kn = pf.key + 7;
+    for (size_t i = 0; i < klen; i++) {
+        if (off + i >= len)
+            return false;
+        uint8_t ch = payload[off + i];
+        if (ch >= 'A' && ch <= 'Z')
+            ch = (uint8_t)(ch + 32);
+        if (ch != kn[i])
+            return false;
+    }
+    off += klen;
+    if (off + 4 > len)
+        return false;
+    return payload[off] == pf.key[3] && payload[off + 1] == pf.key[4]
+        && payload[off + 2] == pf.key[5] && payload[off + 3] == pf.key[6];
+}
+
+void maybe_cache_fill(Backend &be, uint8_t family, const uint8_t *addr16,
+                      uint16_t port, const uint8_t *payload, size_t len) {
+    if (!be.gen_known || len < 12 + 5 || len > kMaxCacheWire)
+        return;
+    ClientKey ck{};
+    ck.family = family;
+    memcpy(ck.addr, addr16, 16);
+    ck.port = port;
+    uint16_t qid = dnskey_rd16(payload);
+    PendingFill &pf = g_pending_fill[pending_slot(ck, qid)];
+    if (!pf.used || pf.qid != qid || !(pf.client == ck)
+            || pf.backend_id != be.id || pf.epoch != be.epoch)
+        return;
+    if (!response_matches_key(pf, payload, len))
+        return;                                  /* qid reuse / mismatch */
+    pf.used = false;
+    if ((payload[3] & 0x0F) == 2)                /* SERVFAIL */
+        return;
+    if (dnskey_rd16(payload + 6) > 1)            /* multi-answer */
+        return;
+    backend_cache_insert(be, pf.key, pf.keylen, payload, len);
+}
 
 void route_response(uint8_t family, uint8_t transport,
                     const uint8_t *addr16, uint16_t port,
@@ -505,8 +873,7 @@ void route_response(uint8_t family, uint8_t transport,
         struct sockaddr_storage ss;
         socklen_t slen;
         sockaddr_from_key(k, &ss, &slen);
-        (void)sendto(g_bal.udp_fd, payload, len, 0,
-                     (struct sockaddr *)&ss, slen);
+        udp_out_add(ss, slen, payload, len);
     } else {
         auto it = g_bal.tcp_by_key.find(k);
         if (it == g_bal.tcp_by_key.end()) {
@@ -572,6 +939,7 @@ void handle_backend(int fd, uint32_t events) {
             L = ntohl(L);
             if (L < kFrameHdr || L > kMaxFrame) {
                 logmsg("backend %d protocol error (frame len %u)", be.id, L);
+                udp_out_flush();
                 backend_mark_down(be);
                 return;
             }
@@ -579,15 +947,36 @@ void handle_backend(int fd, uint32_t events) {
             const uint8_t *f = rb.data() + off + 4;
             if (f[0] != kProtoVersion) {
                 logmsg("backend %d protocol version %u", be.id, f[0]);
+                udp_out_flush();
                 backend_mark_down(be);
                 return;
             }
+            if (f[1] == 0) {
+                /* control frame; opcode in the transport byte.  0 =
+                 * generation report: 8 bytes BE in the address field */
+                if (f[2] == 0 && L >= kFrameHdr) {
+                    uint64_t g = 0;
+                    for (int b = 0; b < 8; b++)
+                        g = (g << 8) | f[3 + b];
+                    if (!be.gen_known || be.gen != g)
+                        backend_cache_clear(be);   /* all entries stale */
+                    be.gen = g;
+                    be.gen_known = true;
+                }
+                off += 4 + L;
+                continue;
+            }
             uint16_t port = (uint16_t)((f[19] << 8) | f[20]);
             be.responded++;
+            if (g_bal.cache_ms > 0 && f[2] == kTransportUdp)
+                maybe_cache_fill(be, f[1], f + 3, port, f + kFrameHdr,
+                                 L - kFrameHdr);
             route_response(f[1], f[2], f + 3, port, f + kFrameHdr,
                            L - kFrameHdr);
             off += 4 + L;
         }
+        /* batched UDP responses reference rb — flush before it mutates */
+        udp_out_flush();
         if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
     }
 }
@@ -603,20 +992,30 @@ void handle_stats() {
         snprintf(line, sizeof(line),
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
+                 "  \"cache_hits\": %llu,\n  \"cache_entries\": %zu,\n"
                  "  \"remotes\": %zu,\n  \"backends\": [\n",
                  (unsigned long long)(now_ms() - g_bal.started_at),
                  (unsigned long long)g_bal.udp_queries,
                  (unsigned long long)g_bal.tcp_queries,
-                 (unsigned long long)g_bal.drops, g_bal.remotes.size());
+                 (unsigned long long)g_bal.drops,
+                 (unsigned long long)g_bal.cache_hits,
+                 [] { size_t n = 0;
+                      for (const auto &b : g_bal.backends)
+                          n += b.cache.size();
+                      return n; }(),
+                 g_bal.remotes.size());
         out += line;
         for (size_t i = 0; i < g_bal.backends.size(); i++) {
             const Backend &be = g_bal.backends[i];
             snprintf(line, sizeof(line),
                      "    {\"id\": %d, \"path\": \"%s\", \"healthy\": %s, "
-                     "\"forwarded\": %llu, \"responded\": %llu}%s\n",
+                     "\"forwarded\": %llu, \"responded\": %llu, "
+                     "\"gen_known\": %s, \"gen\": %llu}%s\n",
                      be.id, be.path.c_str(), be.healthy ? "true" : "false",
                      (unsigned long long)be.forwarded,
                      (unsigned long long)be.responded,
+                     be.gen_known ? "true" : "false",
+                     (unsigned long long)be.gen,
                      i + 1 < g_bal.backends.size() ? "," : "");
             out += line;
         }
@@ -698,16 +1097,18 @@ void report_port() {
 
 int main(int argc, char **argv) {
     int c;
-    while ((c = getopt(argc, argv, "d:p:b:s:v")) != -1) {
+    while ((c = getopt(argc, argv, "d:p:b:s:c:v")) != -1) {
         switch (c) {
         case 'd': g_bal.sockdir = optarg; break;
         case 'p': g_bal.port = atoi(optarg); break;
         case 'b': g_bal.bind_addr = optarg; break;
         case 's': g_bal.scan_ms = atoi(optarg); break;
+        case 'c': g_bal.cache_ms = atoi(optarg); break;
         case 'v': g_verbose = 1; break;
         default:
             fprintf(stderr, "usage: mbalancer -d sockdir [-p port] "
-                            "[-b bindaddr] [-s scan_ms] [-v]\n");
+                            "[-b bindaddr] [-s scan_ms] [-c cache_ms] "
+                            "[-v]\n");
             return 1;
         }
     }

@@ -1,0 +1,143 @@
+/*
+ * Shared DNS question-key builder.
+ *
+ * Single source of truth for the cache key used by every native answer
+ * cache — the in-process fast path (native/fastio/fastpath.c) and the
+ * balancer's cache (native/balancer/mbalancer.cpp) — and mirrored by the
+ * Python pusher (BinderServer._fastpath_key).  The key covers exactly
+ * the decoded fields a binder response depends on:
+ *
+ *   [0]    flags: bit0 RD, bit1 EDNS-present
+ *   [1:3]  effective max UDP payload, big endian
+ *   [3:5]  qtype BE
+ *   [5:7]  qclass BE
+ *   [7:]   lowercased qname, wire label format incl. terminating 0x00
+ *
+ * EDNS option bytes (cookies, padding) vary per packet and are
+ * deliberately NOT keyed.  Only plain hostname-charset names take the
+ * fast path; anything else — multi-question, non-QUERY opcode,
+ * compression in the question, unknown additionals, trailing bytes —
+ * returns 0 ("not eligible", not an error) and is handled by the full
+ * resolution path, which is always correct.
+ */
+#ifndef BINDER_DNSKEY_H
+#define BINDER_DNSKEY_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#define DNSKEY_MAX 272            /* 7 fixed + 255 name + slack */
+#define DNSKEY_CLASSIC_PAYLOAD 512 /* wire.py MAX_UDP_PAYLOAD */
+
+/* charset a fast-path name label may use; the Python decoder replaces
+ * other bytes, so only this subset round-trips identically between the
+ * native and Python key builders (plain function: C++ lacks C99
+ * designated array initializers) */
+static inline int
+dnskey_name_ok(uint8_t c)
+{
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+           (c >= '0' && c <= '9') || c == '-' || c == '_';
+}
+
+static inline uint16_t
+dnskey_rd16(const uint8_t *p)
+{
+    return (uint16_t)((p[0] << 8) | p[1]);
+}
+
+/*
+ * Parse a query packet far enough to build its cache key.  Returns the
+ * key length (>= 8) on success and fills key (>= DNSKEY_MAX bytes),
+ * *qn_len_out (qname wire length incl. terminator) and *qtype_out;
+ * returns 0 when the packet is not fast-path eligible.
+ */
+static inline size_t
+dnskey_build(const uint8_t *buf, size_t len, uint8_t *key,
+             size_t *qn_len_out, uint16_t *qtype_out)
+{
+    if (len < 12 + 1 + 4)
+        return 0;
+    uint16_t flags = dnskey_rd16(buf + 2);
+    if (flags & 0x8000)                 /* QR: a response */
+        return 0;
+    if ((flags >> 11) & 0xF)            /* opcode != QUERY */
+        return 0;
+    if (flags & 0x0200)                 /* TC on a query: punt */
+        return 0;
+    uint16_t qd = dnskey_rd16(buf + 4), an = dnskey_rd16(buf + 6);
+    uint16_t ns = dnskey_rd16(buf + 8), ar = dnskey_rd16(buf + 10);
+    if (qd != 1 || an != 0 || ns != 0 || ar > 1)
+        return 0;
+
+    size_t off = 12;
+    uint8_t *kn = key + 7;
+    for (;;) {
+        if (off >= len)
+            return 0;
+        uint8_t l = buf[off];
+        if (l == 0) {
+            kn[off - 12] = 0;
+            off++;
+            break;
+        }
+        if (l & 0xC0)                   /* compressed/reserved label */
+            return 0;
+        if (off + 1 + l > len || (off - 12) + 1 + (size_t)l > 255)
+            return 0;
+        kn[off - 12] = l;
+        for (uint8_t i = 1; i <= l; i++) {
+            uint8_t ch = buf[off + i];
+            if (!dnskey_name_ok(ch))
+                return 0;
+            /* ASCII lowercase */
+            kn[off - 12 + i] = (uint8_t)((ch >= 'A' && ch <= 'Z')
+                                         ? ch + 32 : ch);
+        }
+        off += 1 + (size_t)l;
+    }
+    size_t qn_len = off - 12;           /* includes terminator */
+    if (off + 4 > len)
+        return 0;
+    uint16_t qtype = dnskey_rd16(buf + off);
+    uint16_t qclass = dnskey_rd16(buf + off + 2);
+    off += 4;
+
+    int edns = 0;
+    unsigned payload = DNSKEY_CLASSIC_PAYLOAD;
+    if (ar == 1) {
+        /* exactly one additional, and it must be a root-name OPT that
+         * ends the packet (other shapes go to the full path) */
+        if (off + 11 > len)
+            return 0;
+        if (buf[off] != 0)
+            return 0;
+        uint16_t rtype = dnskey_rd16(buf + off + 1);
+        if (rtype != 41)                /* not OPT (e.g. TSIG) */
+            return 0;
+        uint16_t rclass = dnskey_rd16(buf + off + 3);
+        uint16_t rdlen = dnskey_rd16(buf + off + 9);
+        if (off + 11 + (size_t)rdlen != len)
+            return 0;
+        edns = 1;
+        /* wire.py Message.max_udp_payload: >=512 → min(size, 4096),
+         * else classic 512 */
+        payload = rclass >= 512 ? (rclass > 4096 ? 4096 : rclass)
+                                : DNSKEY_CLASSIC_PAYLOAD;
+    } else if (off != len) {
+        return 0;                       /* trailing bytes: punt */
+    }
+
+    key[0] = (uint8_t)(((flags & 0x0100) ? 1 : 0) | (edns ? 2 : 0));
+    key[1] = (uint8_t)(payload >> 8);
+    key[2] = (uint8_t)(payload & 0xFF);
+    key[3] = (uint8_t)(qtype >> 8);
+    key[4] = (uint8_t)(qtype & 0xFF);
+    key[5] = (uint8_t)(qclass >> 8);
+    key[6] = (uint8_t)(qclass & 0xFF);
+    *qn_len_out = qn_len;
+    *qtype_out = qtype;
+    return 7 + qn_len;
+}
+
+#endif /* BINDER_DNSKEY_H */

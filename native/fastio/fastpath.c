@@ -50,17 +50,17 @@
 #include <sys/socket.h>
 #include <time.h>
 
+#include "../common/dnskey.h"
 #include "fastpath.h"
 
 #define FP_BATCH FASTIO_BATCH
 #define FP_MAX_VARIANTS 8
 #define FP_PROBE 8
 #define FP_MAX_WIRE 4096          /* larger responses stay in Python */
-#define FP_MAX_KEY 272            /* 7 fixed + 255 name + slack */
+#define FP_MAX_KEY DNSKEY_MAX
 #define FP_MAX_QTYPES 16
 #define FP_MAX_BUCKETS 24
 #define FP_MAX_TOTAL_BYTES (64u << 20)
-#define FP_CLASSIC_PAYLOAD 512    /* wire.py MAX_UDP_PAYLOAD */
 #define FP_QTYPE_OTHER 0xFFFF     /* stats catch-all past FP_MAX_QTYPES */
 
 typedef struct {
@@ -238,129 +238,13 @@ fp_qstat(fp_cache_t *c, uint16_t qtype)
 
 /* ---------------- key construction / wire parsing ---------------- */
 
-/* charset a fast-path name label may use; anything else goes to Python
- * (Python decodes arbitrary bytes with replacement, so only this safe
- * subset round-trips identically between the two key builders) */
-static const uint8_t fp_name_ok[256] = {
-    ['a'] = 1, ['b'] = 1, ['c'] = 1, ['d'] = 1, ['e'] = 1, ['f'] = 1,
-    ['g'] = 1, ['h'] = 1, ['i'] = 1, ['j'] = 1, ['k'] = 1, ['l'] = 1,
-    ['m'] = 1, ['n'] = 1, ['o'] = 1, ['p'] = 1, ['q'] = 1, ['r'] = 1,
-    ['s'] = 1, ['t'] = 1, ['u'] = 1, ['v'] = 1, ['w'] = 1, ['x'] = 1,
-    ['y'] = 1, ['z'] = 1,
-    ['A'] = 1, ['B'] = 1, ['C'] = 1, ['D'] = 1, ['E'] = 1, ['F'] = 1,
-    ['G'] = 1, ['H'] = 1, ['I'] = 1, ['J'] = 1, ['K'] = 1, ['L'] = 1,
-    ['M'] = 1, ['N'] = 1, ['O'] = 1, ['P'] = 1, ['Q'] = 1, ['R'] = 1,
-    ['S'] = 1, ['T'] = 1, ['U'] = 1, ['V'] = 1, ['W'] = 1, ['X'] = 1,
-    ['Y'] = 1, ['Z'] = 1,
-    ['0'] = 1, ['1'] = 1, ['2'] = 1, ['3'] = 1, ['4'] = 1, ['5'] = 1,
-    ['6'] = 1, ['7'] = 1, ['8'] = 1, ['9'] = 1,
-    ['-'] = 1, ['_'] = 1,
-};
-
-static inline uint16_t
-rd16(const uint8_t *p)
-{
-    return (uint16_t)((p[0] << 8) | p[1]);
-}
-
-/*
- * Parse a query packet far enough to build its cache key.  Returns the
- * key length on success and fills key/qn_len/qtype; returns 0 when the
- * packet must go to Python (not an error — just not fast-path eligible).
- *
- * Key layout (the Python pusher in binder_tpu/server.py builds the
- * identical bytes — keep in lockstep):
- *   [0]    flags: bit0 RD, bit1 EDNS-present
- *   [1:3]  effective max UDP payload, big endian
- *   [3:5]  qtype BE
- *   [5:7]  qclass BE
- *   [7:]   lowercased qname, wire label format incl. terminating 0x00
- */
+/* key construction delegates to the shared builder (kept in lockstep
+ * with the balancer cache and the Python pusher) */
 static size_t
 fp_build_key(const uint8_t *buf, size_t len, uint8_t *key,
              size_t *qn_len_out, uint16_t *qtype_out)
 {
-    if (len < 12 + 1 + 4)
-        return 0;
-    uint16_t flags = rd16(buf + 2);
-    if (flags & 0x8000)                 /* QR: a response */
-        return 0;
-    if ((flags >> 11) & 0xF)            /* opcode != QUERY */
-        return 0;
-    if (flags & 0x0200)                 /* TC on a query: let Python decide */
-        return 0;
-    uint16_t qd = rd16(buf + 4), an = rd16(buf + 6);
-    uint16_t ns = rd16(buf + 8), ar = rd16(buf + 10);
-    if (qd != 1 || an != 0 || ns != 0 || ar > 1)
-        return 0;
-
-    size_t off = 12;
-    uint8_t *kn = key + 7;
-    for (;;) {
-        if (off >= len)
-            return 0;
-        uint8_t l = buf[off];
-        if (l == 0) {
-            kn[off - 12] = 0;
-            off++;
-            break;
-        }
-        if (l & 0xC0)                   /* compressed/reserved label */
-            return 0;
-        if (off + 1 + l > len || (off - 12) + 1 + l > 255)
-            return 0;
-        kn[off - 12] = l;
-        for (uint8_t i = 1; i <= l; i++) {
-            uint8_t ch = buf[off + i];
-            if (!fp_name_ok[ch])
-                return 0;
-            /* ASCII lowercase */
-            kn[off - 12 + i] = (ch >= 'A' && ch <= 'Z') ? ch + 32 : ch;
-        }
-        off += 1 + (size_t)l;
-    }
-    size_t qn_len = off - 12;           /* includes terminator */
-    if (off + 4 > len)
-        return 0;
-    uint16_t qtype = rd16(buf + off), qclass = rd16(buf + off + 2);
-    off += 4;
-
-    int edns = 0;
-    unsigned payload = FP_CLASSIC_PAYLOAD;
-    if (ar == 1) {
-        /* exactly one additional, and it must be a root-name OPT that
-         * ends the packet (wire.py Message.decode tolerates more, but
-         * those shapes go to Python) */
-        if (off + 11 > len)
-            return 0;
-        if (buf[off] != 0)
-            return 0;
-        uint16_t rtype = rd16(buf + off + 1);
-        if (rtype != 41)                /* not OPT (e.g. TSIG) */
-            return 0;
-        uint16_t rclass = rd16(buf + off + 3);
-        uint16_t rdlen = rd16(buf + off + 9);
-        if (off + 11 + (size_t)rdlen != len)
-            return 0;
-        edns = 1;
-        /* wire.py Message.max_udp_payload: >=512 → min(size, 4096),
-         * else classic 512 */
-        payload = rclass >= 512 ? (rclass > 4096 ? 4096 : rclass)
-                                : FP_CLASSIC_PAYLOAD;
-    } else if (off != len) {
-        return 0;                       /* trailing bytes: Python decides */
-    }
-
-    key[0] = (uint8_t)(((flags & 0x0100) ? 1 : 0) | (edns ? 2 : 0));
-    key[1] = (uint8_t)(payload >> 8);
-    key[2] = (uint8_t)(payload & 0xFF);
-    key[3] = (uint8_t)(qtype >> 8);
-    key[4] = (uint8_t)(qtype & 0xFF);
-    key[5] = (uint8_t)(qclass >> 8);
-    key[6] = (uint8_t)(qclass & 0xFF);
-    *qn_len_out = qn_len;
-    *qtype_out = qtype;
-    return 7 + qn_len;
+    return dnskey_build(buf, len, key, qn_len_out, qtype_out);
 }
 
 /* Append (payload, addr) to the miss list in recv_batch's item format.

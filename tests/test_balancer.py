@@ -46,10 +46,10 @@ async def start_backend(sockdir, instance, tag):
     return server
 
 
-async def start_balancer(sockdir, scan_ms=150):
+async def start_balancer(sockdir, scan_ms=150, cache_ms=60000):
     proc = await asyncio.create_subprocess_exec(
         BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-        "-s", str(scan_ms),
+        "-s", str(scan_ms), "-c", str(cache_ms),
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.DEVNULL)
     line = await asyncio.wait_for(proc.stdout.readline(), 5)
@@ -179,7 +179,8 @@ class TestBalancer:
         async def run():
             b1 = await start_backend(sockdir, 5301, 1)
             b2 = await start_backend(sockdir, 5302, 2)
-            proc, port = await start_balancer(sockdir)
+            # cache off: this test counts forwards to prove affinity
+            proc, port = await start_balancer(sockdir, cache_ms=0)
             try:
                 await asyncio.sleep(0.4)
                 addrs = set()
@@ -222,3 +223,172 @@ class TestBalancer:
         assert before["backends"] == []
         assert r.rcode == Rcode.NOERROR
         assert len(after["backends"]) == 1
+
+
+class TestBalancerCache:
+    """The balancer's answer cache (mbalancer -c): repeat single-answer
+    UDP queries are served without a forward, invalidated by the
+    backend's generation control frames on store mutation."""
+
+    def test_repeat_queries_cached_and_invalidated(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/web",
+                           {"type": "host",
+                            "host": {"address": "10.42.0.7"}})
+            store.start_session()
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="dc0", host="127.0.0.1",
+                                  port=0,
+                                  balancer_socket=os.path.join(sockdir,
+                                                               "0"),
+                                  collector=MetricsCollector())
+            await server.start()
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)
+                for i in range(5):
+                    r = await udp_ask(port, "web.foo.com", Type.A,
+                                      qid=i + 1)
+                    assert r.id == i + 1
+                    assert r.answers[0].address == "10.42.0.7"
+                stats = read_stats(sockdir)
+                assert stats["cache_hits"] == 4, stats
+                assert stats["cache_entries"] == 1
+                assert stats["backends"][0]["forwarded"] == 1
+                assert stats["backends"][0]["gen_known"] is True
+
+                # store mutation -> gen frame -> cached entry is stale
+                store.put_json("/com/foo/web",
+                               {"type": "host",
+                                "host": {"address": "10.42.0.99"}})
+                await asyncio.sleep(0.2)   # frame delivery
+                r = await udp_ask(port, "web.foo.com", Type.A, qid=99)
+                assert r.answers[0].address == "10.42.0.99"
+                stats = read_stats(sockdir)
+                assert stats["backends"][0]["forwarded"] == 2
+                # and the fresh answer is cached again
+                r = await udp_ask(port, "web.foo.com", Type.A, qid=100)
+                assert r.answers[0].address == "10.42.0.99"
+                stats = read_stats(sockdir)
+                assert stats["backends"][0]["forwarded"] == 2
+            finally:
+                proc.kill()
+                await proc.wait()
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_multi_answer_rotation_bypasses_cache(self, tmp_path):
+        sockdir = str(tmp_path)
+
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/svc", {
+                "type": "service",
+                "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432},
+            })
+            for i in range(4):
+                store.put_json(f"/com/foo/svc/lb{i}",
+                               {"type": "load_balancer",
+                                "load_balancer":
+                                    {"address": f"10.0.1.{i + 1}"}})
+            store.start_session()
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="dc0", host="127.0.0.1",
+                                  port=0,
+                                  balancer_socket=os.path.join(sockdir,
+                                                               "0"),
+                                  collector=MetricsCollector())
+            await server.start()
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)
+                orderings = set()
+                for i in range(10):
+                    r = await udp_ask(port, "svc.foo.com", Type.A,
+                                      qid=i + 1)
+                    assert len(r.answers) == 4
+                    orderings.add(tuple(a.address for a in r.answers))
+                stats = read_stats(sockdir)
+                # multi-answer responses are never cached: every query
+                # reached the backend, and rotation is visible
+                assert stats["cache_hits"] == 0
+                assert stats["backends"][0]["forwarded"] == 10
+                assert len(orderings) > 1
+            finally:
+                proc.kill()
+                await proc.wait()
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_qid_reuse_cannot_poison_cache(self, tmp_path):
+        """Two in-flight queries under one (client, qid) for different
+        names: the response for name A must not be cached under name
+        B's key (the fill verifies the response's echoed question)."""
+        sockdir = str(tmp_path)
+
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/aaa",
+                           {"type": "host",
+                            "host": {"address": "10.42.1.1"}})
+            store.put_json("/com/foo/bbb",
+                           {"type": "host",
+                            "host": {"address": "10.42.2.2"}})
+            store.start_session()
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="dc0", host="127.0.0.1",
+                                  port=0,
+                                  balancer_socket=os.path.join(sockdir,
+                                                               "0"),
+                                  collector=MetricsCollector())
+            await server.start()
+            proc, port = await start_balancer(sockdir)
+            try:
+                await asyncio.sleep(0.4)
+                loop = asyncio.get_running_loop()
+                got = []
+                done = loop.create_future()
+
+                class Proto(asyncio.DatagramProtocol):
+                    def connection_made(self, transport):
+                        # same qid, two names, back-to-back: the second
+                        # overwrites the pending-fill slot before the
+                        # first response returns
+                        transport.sendto(make_query(
+                            "aaa.foo.com", Type.A, qid=7).encode())
+                        transport.sendto(make_query(
+                            "bbb.foo.com", Type.A, qid=7).encode())
+
+                    def datagram_received(self, data, addr):
+                        got.append(Message.decode(data))
+                        if len(got) == 2 and not done.done():
+                            done.set_result(None)
+
+                transport, _ = await loop.create_datagram_endpoint(
+                    Proto, remote_addr=("127.0.0.1", port))
+                await asyncio.wait_for(done, 5)
+                transport.close()
+
+                # now bbb must resolve to bbb's address, repeatedly
+                # (cached or not) — a poisoned cache would serve aaa's
+                for i in range(4):
+                    r = await udp_ask(port, "bbb.foo.com", Type.A,
+                                      qid=100 + i)
+                    assert r.answers[0].address == "10.42.2.2", \
+                        [str(a.address) for a in r.answers]
+                r = await udp_ask(port, "aaa.foo.com", Type.A, qid=200)
+                assert r.answers[0].address == "10.42.1.1"
+            finally:
+                proc.kill()
+                await proc.wait()
+                await server.stop()
+
+        asyncio.run(run())
