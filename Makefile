@@ -1,0 +1,29 @@
+# Top-level targets mirroring the reference's Makefile surface
+# (`make test` / `make check`, reference Makefile:169-171 + Jenkinsfile).
+
+PY ?= python3
+
+.PHONY: all native test check bench clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+# style/consistency gate (the reference's `make check` runs jsstyle/jsl;
+# here: byte-compile everything and keep the native build warning-clean)
+check:
+	$(PY) -m compileall -q binder_tpu tests bench.py bench_impl.py \
+		__graft_entry__.py
+	$(MAKE) -C native CXXFLAGS="-O2 -g -Wall -Wextra -Werror -std=c++17" \
+		CFLAGS="-O2 -g -Wall -Wextra -Werror"
+
+bench: native
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -432,6 +432,36 @@ class TestFastpathIntegration:
                 await server.stop()
         asyncio.run(run())
 
+    def test_read_your_writes_under_churn(self):
+        """Mutate-then-query loop through the full UDP stack with the
+        fast path active: the fake store applies mutations to the
+        mirror synchronously, so every query after a mutation MUST see
+        the new value — any stale answer means a cache (Python or C)
+        survived a generation bump."""
+        async def run():
+            import random
+            rng = random.Random(1234)
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                addr = None
+                for i in range(60):
+                    addr = f"10.7.{rng.randrange(256)}.{rng.randrange(1, 255)}"
+                    store.put_json(
+                        "/com/foo/web",
+                        {"type": "host", "host": {"address": addr}})
+                    # a few queries per mutation: the first re-resolves,
+                    # the rest exercise both cache layers
+                    for j in range(3):
+                        m = await udp_ask(server.udp_port, "web.foo.com",
+                                          Type.A, qid=(i * 4 + j) % 65536)
+                        assert m.answers[0].address == addr, \
+                            (i, j, m.answers[0].address, addr)
+                assert fp_hits(server) > 0   # the C path did serve
+            finally:
+                await server.stop()
+        asyncio.run(run())
+
     def test_refused_responses_cached_and_served(self):
         async def run():
             _, cache = fixture_store()
