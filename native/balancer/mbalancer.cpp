@@ -198,8 +198,17 @@ struct Stream {
 
 struct CacheEntry {
     double expire_at = 0;
-    std::vector<uint8_t> wire;
+    /* Round-robin preservation, mirroring the backend answer cache
+     * (binder_tpu/resolver/answer_cache.py): multi-answer responses
+     * are collected until kCacheVariants independent shuffles exist,
+     * and only then served, cycling through them.  Single-answer
+     * entries are complete at one variant. */
+    std::vector<std::vector<uint8_t>> wires;
+    uint8_t next_variant = 0;
+    bool complete = false;
+    size_t bytes = 0;
 };
+constexpr size_t kCacheVariants = 8;
 uint64_t g_cache_bytes = 0;           /* across all backends */
 constexpr size_t kMaxCacheEntriesPerBackend = 65536;
 constexpr uint64_t kMaxCacheBytes = 64ull << 20;
@@ -442,8 +451,9 @@ std::vector<uint8_t> make_frame(const ClientKey &k, uint8_t transport,
  *  - a reconnect bumps the epoch, retiring all prior entries (a
  *    restarted backend's generation counter restarts);
  *  - time expiry (-c <ms>, default 60 s, 0 disables);
- *  - multi-answer responses are never cached, so round-robin rotation
- *    still happens in the backends;
+ *  - round-robin rotation is preserved like the backend cache
+ *    preserves it: multi-answer entries collect kCacheVariants
+ *    independent shuffles before serving, then cycle through them;
  *  - SERVFAIL is never cached (matches BinderServer._on_query).
  * Fill state rides a fixed pending table keyed by (client, qid): the
  * forward records the question key, the matching response harvests it.
@@ -485,7 +495,7 @@ void backend_cache_clear(Backend &be) {
 }
 
 void backend_cache_insert(Backend &be, const uint8_t *key, size_t keylen,
-                          const uint8_t *wire, size_t len) {
+                          const uint8_t *wire, size_t len, bool rotatable) {
     if (be.cache.size() >= kMaxCacheEntriesPerBackend ||
         g_cache_bytes + len > kMaxCacheBytes) {
         /* bounded reset, like the affinity table: the cache is an
@@ -493,18 +503,19 @@ void backend_cache_insert(Backend &be, const uint8_t *key, size_t keylen,
         backend_cache_clear(be);
     }
     std::string mkey((const char *)key, keylen);
-    auto it = be.cache.find(mkey);
-    if (it != be.cache.end()) {
-        g_cache_bytes -= it->second.wire.size();
-        be.cache_bytes -= it->second.wire.size();
-        be.cache.erase(it);
+    CacheEntry &e = be.cache[mkey];
+    if (e.wires.empty()) {
+        e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
+    } else if (e.complete || e.wires.size() >= kCacheVariants) {
+        return;   /* late fill from a pre-completion forward */
     }
-    CacheEntry e;
-    e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
-    e.wire.assign(wire, wire + len);
+    e.wires.emplace_back(wire, wire + len);
+    e.bytes += len;
     g_cache_bytes += len;
     be.cache_bytes += len;
-    be.cache.emplace(std::move(mkey), std::move(e));
+    /* single-answer responses have nothing to rotate; rotatable ones
+     * serve only once enough independent shuffles are collected */
+    e.complete = !rotatable || e.wires.size() >= kCacheVariants;
 }
 
 /* Backends with frames queued this event-loop pass; flushed once per
@@ -675,21 +686,29 @@ void handle_udp() {
                     auto it = be.cache.find(lookup_key);
                     if (it != be.cache.end()) {
                         CacheEntry &e = it->second;
-                        if (mono_s() <= e.expire_at
-                                && e.wire.size() >= 12 + qn_len + 4) {
-                            uint8_t *out = udp_out_add_copy(
-                                addrs[i], msgs[i].msg_hdr.msg_namelen,
-                                e.wire.data(), e.wire.size());
-                            out[0] = pkt[0];        /* request id */
-                            out[1] = pkt[1];
-                            /* 0x20 case echo */
-                            memcpy(out + 12, pkt + 12, qn_len + 4);
-                            g_bal.cache_hits++;
-                            continue;
+                        if (mono_s() > e.expire_at) {
+                            g_cache_bytes -= e.bytes;
+                            be.cache_bytes -= e.bytes;
+                            be.cache.erase(it);   /* expired */
+                        } else if (e.complete) {
+                            const auto &w = e.wires[
+                                e.next_variant % e.wires.size()];
+                            e.next_variant = (uint8_t)(
+                                (e.next_variant + 1) % e.wires.size());
+                            if (w.size() >= 12 + qn_len + 4) {
+                                uint8_t *out = udp_out_add_copy(
+                                    addrs[i], msgs[i].msg_hdr.msg_namelen,
+                                    w.data(), w.size());
+                                out[0] = pkt[0];    /* request id */
+                                out[1] = pkt[1];
+                                /* 0x20 case echo */
+                                memcpy(out + 12, pkt + 12, qn_len + 4);
+                                g_bal.cache_hits++;
+                                continue;
+                            }
                         }
-                        g_cache_bytes -= e.wire.size();
-                        be.cache_bytes -= e.wire.size();
-                        be.cache.erase(it);   /* expired */
+                        /* incomplete: keep forwarding so responses
+                         * collect more shuffle variants */
                     }
                     /* miss: remember the key so the response can fill */
                     PendingFill &pf = g_pending_fill[
@@ -795,9 +814,9 @@ void handle_tcp_client(int fd, uint32_t events) {
 /* ---------------- backend responses ---------------- */
 
 /* Harvest a forwarded response into the answer cache when its pending
- * record matches (see the miss path in handle_udp).  Only single-answer
- * (rotation lives in the backends), non-SERVFAIL UDP responses under a
- * known backend generation are cacheable. */
+ * record matches (see the miss path in handle_udp).  Non-SERVFAIL UDP
+ * responses under a known backend generation are cacheable;
+ * multi-answer responses enter as rotation variants (CacheEntry). */
 /* The pending record alone is NOT proof the response answers the
  * recorded question: (client, qid) collide whenever a client has two
  * queries in flight under one qid (routine for stub resolvers), and a
@@ -856,9 +875,8 @@ void maybe_cache_fill(Backend &be, uint8_t family, const uint8_t *addr16,
     pf.used = false;
     if ((payload[3] & 0x0F) == 2)                /* SERVFAIL */
         return;
-    if (dnskey_rd16(payload + 6) > 1)            /* multi-answer */
-        return;
-    backend_cache_insert(be, pf.key, pf.keylen, payload, len);
+    backend_cache_insert(be, pf.key, pf.keylen, payload, len,
+                         /* rotatable= */ dnskey_rd16(payload + 6) > 1);
 }
 
 void route_response(uint8_t family, uint8_t transport,

@@ -282,7 +282,7 @@ class TestBalancerCache:
 
         asyncio.run(run())
 
-    def test_multi_answer_rotation_bypasses_cache(self, tmp_path):
+    def test_multi_answer_collects_variants_then_rotates(self, tmp_path):
         sockdir = str(tmp_path)
 
         async def run():
@@ -308,18 +308,21 @@ class TestBalancerCache:
             proc, port = await start_balancer(sockdir)
             try:
                 await asyncio.sleep(0.4)
-                orderings = set()
-                for i in range(10):
+                orderings = []
+                for i in range(24):
                     r = await udp_ask(port, "svc.foo.com", Type.A,
                                       qid=i + 1)
                     assert len(r.answers) == 4
-                    orderings.add(tuple(a.address for a in r.answers))
+                    orderings.append(tuple(a.address for a in r.answers))
                 stats = read_stats(sockdir)
-                # multi-answer responses are never cached: every query
-                # reached the backend, and rotation is visible
-                assert stats["cache_hits"] == 0
-                assert stats["backends"][0]["forwarded"] == 10
-                assert len(orderings) > 1
+                # collect-then-serve: the first 8 responses fill the
+                # variant set (all forwarded), everything after is a
+                # cache hit cycling through the collected shuffles
+                assert stats["backends"][0]["forwarded"] == 8, stats
+                assert stats["cache_hits"] == 16
+                # rotation stays visible through the cache
+                assert len(set(orderings)) > 1
+                assert len(set(orderings[8:])) > 1
             finally:
                 proc.kill()
                 await proc.wait()
