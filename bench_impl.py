@@ -207,7 +207,58 @@ def _drive_native(port: int, tmpdir: str) -> Dict[str, float]:
     return json.loads(out.stdout)
 
 
+MBALANCER = os.path.join(ROOT, "native", "build", "mbalancer")
+
+
+def _bench_topology(tmpdir: str) -> Dict[str, float]:
+    """Deployment-shape measurement: mbalancer fronting 2 backends over
+    the balancer socket protocol, driven with the same query mix.  Two
+    passes; the second (warm balancer cache) is reported."""
+    sockdir = os.path.join(tmpdir, "vsock")
+    os.mkdir(sockdir)
+    backends = []
+    for i in range(2):
+        fixture = os.path.join(tmpdir, "fixture.json")
+        config = os.path.join(tmpdir, f"bconfig{i}.json")
+        with open(config, "w") as f:
+            json.dump({
+                "dnsDomain": "bench.com", "datacenterName": "dc0",
+                "host": "127.0.0.1",
+                "store": {"backend": "fake", "fixture": fixture},
+                "queryLog": False,
+                "balancerSocket": os.path.join(sockdir, str(i)),
+            }, f)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.Popen(
+            [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
+             "-p", "0"],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        wait_for_port(p)
+        backends.append(p)
+    bal = subprocess.Popen(
+        [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+         "-s", "300"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    try:
+        line = bal.stdout.readline()
+        port = int(line.split()[1])
+        time.sleep(0.5)   # backend scan + connect
+        _bench_topology_res = None
+        for _ in range(2):   # pass 1 warms the balancer cache
+            _bench_topology_res = _drive_native(port, tmpdir)
+        return _bench_topology_res
+    finally:
+        bal.terminate()
+        bal.wait(timeout=10)
+        for p in backends:
+            p.terminate()
+            p.wait(timeout=10)
+
+
 def run_bench() -> Dict[str, object]:
+    topo = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -219,6 +270,11 @@ def run_bench() -> Dict[str, object]:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+        if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
+            try:
+                topo = _bench_topology(tmpdir)
+            except Exception:
+                topo = None   # topology figure is supplementary
 
     baseline = None
     if os.path.exists(BASELINE_FILE):
@@ -236,7 +292,7 @@ def run_bench() -> Dict[str, object]:
                                "publishes no numbers (BASELINE.md)"}, f)
         baseline = res["qps"]
 
-    return {
+    out = {
         "metric": "dns_queries_per_sec",
         "value": round(res["qps"], 1),
         "unit": "qps",
@@ -248,3 +304,8 @@ def run_bench() -> Dict[str, object]:
         "queries": N_QUERIES,
         "concurrency": CONCURRENCY,
     }
+    if topo is not None:
+        # supplementary: deployment shape (balancer + 2 backends), warm
+        out["topology_qps"] = round(topo["qps"], 1)
+        out["topology_p50_us"] = round(topo["p50_us"], 1)
+    return out
