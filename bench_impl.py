@@ -216,45 +216,57 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
     passes; the second (warm balancer cache) is reported."""
     sockdir = os.path.join(tmpdir, "vsock")
     os.mkdir(sockdir)
-    backends = []
-    for i in range(2):
-        fixture = os.path.join(tmpdir, "fixture.json")
-        config = os.path.join(tmpdir, f"bconfig{i}.json")
-        with open(config, "w") as f:
-            json.dump({
-                "dnsDomain": "bench.com", "datacenterName": "dc0",
-                "host": "127.0.0.1",
-                "store": {"backend": "fake", "fixture": fixture},
-                "queryLog": False,
-                "balancerSocket": os.path.join(sockdir, str(i)),
-            }, f)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        p = subprocess.Popen(
-            [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
-             "-p", "0"],
-            cwd=ROOT, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL)
-        wait_for_port(p)
-        backends.append(p)
-    bal = subprocess.Popen(
-        [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-         "-s", "300"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    fixture = os.path.join(tmpdir, "fixture.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+
+    def _reap(proc):
+        try:
+            proc.terminate()
+            proc.wait(timeout=10)
+        except Exception:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
+    procs = []   # every child, reaped on any exit path
     try:
+        for i in range(2):
+            config = os.path.join(tmpdir, f"bconfig{i}.json")
+            with open(config, "w") as f:
+                json.dump({
+                    "dnsDomain": "bench.com", "datacenterName": "dc0",
+                    "host": "127.0.0.1",
+                    "store": {"backend": "fake", "fixture": fixture},
+                    "queryLog": False,
+                    "balancerSocket": os.path.join(sockdir, str(i)),
+                }, f)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+            p = subprocess.Popen(
+                [sys.executable, "-u", "-m", "binder_tpu.main", "-f",
+                 config, "-p", "0"],
+                cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL)
+            procs.append(p)
+            wait_for_port(p)
+        bal = subprocess.Popen(
+            [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+             "-s", "300"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        procs.append(bal)
         line = bal.stdout.readline()
         port = int(line.split()[1])
         time.sleep(0.5)   # backend scan + connect
-        _bench_topology_res = None
+        res = None
         for _ in range(2):   # pass 1 warms the balancer cache
-            _bench_topology_res = _drive_native(port, tmpdir)
-        return _bench_topology_res
+            res = _drive_native(port, tmpdir)
+        return res
     finally:
-        bal.terminate()
-        bal.wait(timeout=10)
-        for p in backends:
-            p.terminate()
-            p.wait(timeout=10)
+        for p in reversed(procs):   # balancer first, then backends
+            _reap(p)
 
 
 def run_bench() -> Dict[str, object]:

@@ -1045,43 +1045,56 @@ void handle_stats() {
 
 /* ---------------- setup ---------------- */
 
-int listen_udp() {
-    int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
-    if (fd < 0) { perror("socket udp"); exit(1); }
+/* Bind-address family follows -b: a ':' means IPv6 (with V6ONLY off,
+ * so "::" serves both stacks — v4 clients appear as v4-mapped v6
+ * addresses, which the frame protocol and backends already carry as
+ * family-6). Default stays "0.0.0.0". */
+int listen_front(int socktype, const char *what) {
+    bool v6 = g_bal.bind_addr.find(':') != std::string::npos;
+    int fd = socket(v6 ? AF_INET6 : AF_INET, socktype | SOCK_NONBLOCK, 0);
+    if (fd < 0) { perror(what); exit(1); }
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    struct sockaddr_in sin{};
-    sin.sin_family = AF_INET;
-    sin.sin_port = htons((uint16_t)g_bal.port);
-    if (inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr) != 1) {
-        fprintf(stderr, "mbalancer: bad bind address '%s'\n",
-                g_bal.bind_addr.c_str());
-        exit(1);
-    }
-    if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
-        perror("bind udp");
-        exit(1);
+    if (v6) {
+        int zero = 0;
+        setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
+        struct sockaddr_in6 sin6{};
+        sin6.sin6_family = AF_INET6;
+        sin6.sin6_port = htons((uint16_t)g_bal.port);
+        if (inet_pton(AF_INET6, g_bal.bind_addr.c_str(),
+                      &sin6.sin6_addr) != 1) {
+            fprintf(stderr, "mbalancer: bad bind address '%s'\n",
+                    g_bal.bind_addr.c_str());
+            exit(1);
+        }
+        if (bind(fd, (struct sockaddr *)&sin6, sizeof(sin6)) != 0) {
+            perror(what);
+            exit(1);
+        }
+    } else {
+        struct sockaddr_in sin{};
+        sin.sin_family = AF_INET;
+        sin.sin_port = htons((uint16_t)g_bal.port);
+        if (inet_pton(AF_INET, g_bal.bind_addr.c_str(),
+                      &sin.sin_addr) != 1) {
+            fprintf(stderr, "mbalancer: bad bind address '%s'\n",
+                    g_bal.bind_addr.c_str());
+            exit(1);
+        }
+        if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
+            perror(what);
+            exit(1);
+        }
     }
     return fd;
 }
 
+int listen_udp() {
+    return listen_front(SOCK_DGRAM, "bind udp");
+}
+
 int listen_tcp() {
-    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-    if (fd < 0) { perror("socket tcp"); exit(1); }
-    int one = 1;
-    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-    struct sockaddr_in sin{};
-    sin.sin_family = AF_INET;
-    sin.sin_port = htons((uint16_t)g_bal.port);
-    if (inet_pton(AF_INET, g_bal.bind_addr.c_str(), &sin.sin_addr) != 1) {
-        fprintf(stderr, "mbalancer: bad bind address '%s'\n",
-                g_bal.bind_addr.c_str());
-        exit(1);
-    }
-    if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
-        perror("bind tcp");
-        exit(1);
-    }
+    int fd = listen_front(SOCK_STREAM, "bind tcp");
     if (listen(fd, 128) != 0) { perror("listen tcp"); exit(1); }
     return fd;
 }
@@ -1102,12 +1115,18 @@ int listen_stats() {
     return fd;
 }
 
+uint16_t local_port(int fd) {
+    struct sockaddr_storage ss{};
+    socklen_t slen = sizeof(ss);
+    getsockname(fd, (struct sockaddr *)&ss, &slen);
+    if (ss.ss_family == AF_INET6)
+        return ntohs(((struct sockaddr_in6 *)&ss)->sin6_port);
+    return ntohs(((struct sockaddr_in *)&ss)->sin_port);
+}
+
 void report_port() {
     /* with -p 0 (tests), report the kernel-chosen port on stdout */
-    struct sockaddr_in sin{};
-    socklen_t slen = sizeof(sin);
-    getsockname(g_bal.udp_fd, (struct sockaddr *)&sin, &slen);
-    printf("PORT %d\n", ntohs(sin.sin_port));
+    printf("PORT %d\n", local_port(g_bal.udp_fd));
     fflush(stdout);
 }
 
@@ -1145,11 +1164,8 @@ int main(int argc, char **argv) {
     /* Both fronts bind the same port number: if -p 0, rebind TCP to the
      * UDP-chosen port for parity with production (:53/:53). */
     if (g_bal.port == 0) {
-        struct sockaddr_in sin{};
-        socklen_t slen = sizeof(sin);
-        getsockname(g_bal.udp_fd, (struct sockaddr *)&sin, &slen);
         close(g_bal.tcp_fd);
-        g_bal.port = ntohs(sin.sin_port);
+        g_bal.port = local_port(g_bal.udp_fd);
         g_bal.tcp_fd = listen_tcp();
     }
 

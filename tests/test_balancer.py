@@ -46,9 +46,10 @@ async def start_backend(sockdir, instance, tag):
     return server
 
 
-async def start_balancer(sockdir, scan_ms=150, cache_ms=60000):
+async def start_balancer(sockdir, scan_ms=150, cache_ms=60000,
+                         bind="127.0.0.1"):
     proc = await asyncio.create_subprocess_exec(
-        BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+        BALANCER, "-d", sockdir, "-p", "0", "-b", bind,
         "-s", str(scan_ms), "-c", str(cache_ms),
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.DEVNULL)
@@ -57,7 +58,8 @@ async def start_balancer(sockdir, scan_ms=150, cache_ms=60000):
     return proc, int(line.split()[1])
 
 
-async def udp_ask(port, name, qtype, qid=1, timeout=5.0, sock=None):
+async def udp_ask(port, name, qtype, qid=1, timeout=5.0, sock=None,
+                  host="127.0.0.1"):
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
@@ -70,7 +72,7 @@ async def udp_ask(port, name, qtype, qid=1, timeout=5.0, sock=None):
                 fut.set_result(data)
 
     transport, _ = await loop.create_datagram_endpoint(
-        Proto, remote_addr=("127.0.0.1", port))
+        Proto, remote_addr=(host, port))
     try:
         data = await asyncio.wait_for(fut, timeout)
     finally:
@@ -393,5 +395,40 @@ class TestBalancerCache:
                 proc.kill()
                 await proc.wait()
                 await server.stop()
+
+        asyncio.run(run())
+
+
+class TestBalancerV6:
+    def test_ipv6_front(self, tmp_path):
+        """-b with a ':' binds an IPv6 (dual-stack-capable) front; the
+        frame protocol already carries family-6 client addresses."""
+        sockdir = str(tmp_path)
+
+        async def run():
+            b1 = await start_backend(sockdir, 5301, 1)
+            proc, port = await start_balancer(sockdir, bind="::1")
+            try:
+                await asyncio.sleep(0.4)
+                for qid in (5, 6):   # second ask is a balancer-cache hit
+                    m = await udp_ask(port, "web.foo.com", Type.A,
+                                      qid=qid, host="::1")
+                    assert m.id == qid
+                    assert m.answers[0].address == "10.42.0.1"
+
+                # TCP over v6 through the same front
+                reader, writer = await asyncio.open_connection("::1", port)
+                wire = make_query("web.foo.com", Type.A, qid=9).encode()
+                writer.write(struct.pack(">H", len(wire)) + wire)
+                await writer.drain()
+                (ln,) = struct.unpack(">H", await asyncio.wait_for(
+                    reader.readexactly(2), 5))
+                m = Message.decode(await reader.readexactly(ln))
+                assert m.id == 9
+                writer.close()
+            finally:
+                proc.kill()
+                await proc.wait()
+                await b1.stop()
 
         asyncio.run(run())
