@@ -19,8 +19,11 @@ from binder_tpu.server import BinderServer
 from binder_tpu.store import FakeStore, MirrorCache
 
 DOMAIN = "foo.com"
-BALANCER = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "native", "build", "mbalancer")
+# BINDER_BALANCER overrides the binary under test (e.g. the sanitizer
+# build: `make -C native asan` then BINDER_BALANCER=native/build/mbalancer.asan)
+BALANCER = os.environ.get("BINDER_BALANCER") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "mbalancer")
 
 pytestmark = pytest.mark.skipif(
     not os.path.exists(BALANCER),
