@@ -34,7 +34,7 @@ class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
                  "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
-                 "cached_summary")
+                 "cached_summary", "no_store")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -54,6 +54,11 @@ class QueryCtx:
         # balancer ('udp'|'tcp') — decides truncation semantics.
         self.client_transport = client_transport
         self._send = send
+        # set by the recursion handoff: this response is rebuilt from
+        # another DC's data, and no cache layer may keep it (the
+        # balancer-socket transport propagates it as the do-not-store
+        # marker, docs/balancer-protocol.md)
+        self.no_store = False
         self._responded = False
         self.bytes_sent = 0
         self.start = time.monotonic()

@@ -39,6 +39,9 @@ BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
 MAX_FRAME = 65_556
 TRANSPORT_UDP = 0
 TRANSPORT_TCP = 1
+# response-only marker: route like UDP but no cache layer may keep it
+# (recursion answers belong to another DC's store)
+TRANSPORT_UDP_NO_STORE = 2
 
 
 def pack_balancer_frame(family: int, addr: str, port: int,
@@ -66,7 +69,8 @@ def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
         ">BBB16sH", frame, 0)
     if version != BALANCER_VERSION:
         raise WireError(f"unknown balancer protocol version {version}")
-    if transport not in (TRANSPORT_UDP, TRANSPORT_TCP):
+    if transport not in (TRANSPORT_UDP, TRANSPORT_TCP,
+                         TRANSPORT_UDP_NO_STORE):
         raise WireError(f"bad transport {transport}")
     if family == 4:
         addr = str(ipaddress.IPv4Address(raw[:4]))
@@ -121,9 +125,14 @@ class DnsServer:
     def _dispatch(self, request: Message, src: Tuple[str, int],
                   protocol: str, send: Callable[[bytes], None],
                   client_transport: Optional[str] = None,
-                  raw: Optional[bytes] = None) -> None:
+                  raw: Optional[bytes] = None,
+                  ctx_box: Optional[list] = None) -> None:
         query = QueryCtx(request, src, protocol, send,
                          client_transport=client_transport, raw=raw)
+        if ctx_box is not None:
+            # transports that need per-response state (the balancer's
+            # do-not-store marker) observe the context through this box
+            ctx_box.append(query)
         if self.on_query is None:
             query.set_error(Rcode.NOTIMP)
             query.respond()
@@ -213,7 +222,8 @@ class DnsServer:
 
     def _handle_raw(self, data: bytes, src: Tuple[str, int],
                     protocol: str, send: Callable[[bytes], None],
-                    client_transport: Optional[str] = None) -> None:
+                    client_transport: Optional[str] = None,
+                    ctx_box: Optional[list] = None) -> None:
         try:
             request = self._decode_query(data)
         except WireError as e:
@@ -229,7 +239,7 @@ class DnsServer:
         if request.qr:
             return  # not a query
         self._dispatch(request, src, protocol, send, client_transport,
-                       raw=data)
+                       raw=data, ctx_box=ctx_box)
 
     # -- UDP --
 
@@ -491,10 +501,25 @@ class DnsServer:
                 except WireError as e:
                     self.log.error("balancer protocol error: %s", e)
                     return
+                if transport == TRANSPORT_UDP_NO_STORE:
+                    # response-only marker; never valid on a request
+                    self.log.error("balancer protocol error: "
+                                   "do-not-store transport on a request")
+                    return
+
+                ctx_box: list = []
 
                 def send(wire: bytes, f=family, a=addr, p=port,
-                         t=transport) -> None:
-                    out = pack_balancer_frame(f, a, p, wire, transport=t)
+                         t=transport, box=ctx_box) -> None:
+                    # recursion-produced responses carry the
+                    # do-not-store marker so the balancer won't cache
+                    # another DC's data under our generation
+                    t_out = t
+                    if (t == TRANSPORT_UDP and box
+                            and box[0].no_store):
+                        t_out = TRANSPORT_UDP_NO_STORE
+                    out = pack_balancer_frame(f, a, p, wire,
+                                              transport=t_out)
                     # serialize frame writes from concurrent queries
                     async def _write():
                         try:
@@ -511,7 +536,8 @@ class DnsServer:
                 self._handle_raw(
                     payload, (addr, port), "balancer", send,
                     client_transport=("tcp" if transport == TRANSPORT_TCP
-                                      else "udp"))
+                                      else "udp"),
+                    ctx_box=ctx_box)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:

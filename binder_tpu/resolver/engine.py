@@ -173,6 +173,10 @@ class Resolver:
 
         if node is None:
             if self.recursion is not None and query.rd():
+                # recursion answers belong to another DC's store — no
+                # cache layer may keep them (query.no_store reaches the
+                # balancer as the do-not-store transport marker)
+                query.no_store = True
                 return self.recursion.resolve(query)
             # REFUSED, not NXDOMAIN: clients must fail over to their next
             # nameserver (lib/server.js:227-241)
@@ -300,6 +304,7 @@ class Resolver:
         node = self.cache.reverse_lookup(ip)
         if node is None:
             if self.recursion is not None and query.rd():
+                query.no_store = True
                 return self.recursion.resolve(query)
             query.set_error(Rcode.REFUSED)
             query.stamp("pre-resp")

@@ -59,6 +59,9 @@ constexpr size_t kFrameHdr = 21;      /* ver+family+transport+addr16+port */
 constexpr size_t kMaxFrame = 65556;
 constexpr uint8_t kTransportUdp = 0;
 constexpr uint8_t kTransportTcp = 1;
+/* response-only marker from backends: route like UDP, never cache
+ * (recursion answers belong to another DC's store) */
+constexpr uint8_t kTransportUdpNoStore = 2;
 constexpr size_t kMaxUdpPacket = 65535;
 /* Affinity-table cap: the map is keyed by remote host, and mbalancer owns
  * a public UDP port — without a bound, spoofed source addresses would grow
@@ -989,7 +992,9 @@ void handle_backend(int fd, uint32_t events) {
             if (g_bal.cache_ms > 0 && f[2] == kTransportUdp)
                 maybe_cache_fill(be, f[1], f + 3, port, f + kFrameHdr,
                                  L - kFrameHdr);
-            route_response(f[1], f[2], f + 3, port, f + kFrameHdr,
+            uint8_t transport = f[2] == kTransportUdpNoStore
+                ? kTransportUdp : f[2];
+            route_response(f[1], transport, f + 3, port, f + kFrameHdr,
                            L - kFrameHdr);
             off += 4 + L;
         }
@@ -1006,7 +1011,7 @@ void handle_stats() {
         int fd = accept4(g_bal.stats_fd, nullptr, nullptr, SOCK_NONBLOCK);
         if (fd < 0) return;
         std::string out = "{\n";
-        char line[256];
+        char line[512];
         snprintf(line, sizeof(line),
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
@@ -1023,17 +1028,27 @@ void handle_stats() {
                       return n; }(),
                  g_bal.remotes.size());
         out += line;
+        /* one pass over the affinity map (reference be_remotes), not
+         * one scan per backend */
+        std::vector<size_t> remote_counts(g_bal.backends.size(), 0);
+        for (const auto &r : g_bal.remotes) {
+            if (r.second >= 0 &&
+                (size_t)r.second < remote_counts.size())
+                remote_counts[r.second]++;
+        }
         for (size_t i = 0; i < g_bal.backends.size(); i++) {
             const Backend &be = g_bal.backends[i];
             snprintf(line, sizeof(line),
                      "    {\"id\": %d, \"path\": \"%s\", \"healthy\": %s, "
                      "\"forwarded\": %llu, \"responded\": %llu, "
-                     "\"gen_known\": %s, \"gen\": %llu}%s\n",
+                     "\"gen_known\": %s, \"gen\": %llu, "
+                     "\"remotes\": %zu}%s\n",
                      be.id, be.path.c_str(), be.healthy ? "true" : "false",
                      (unsigned long long)be.forwarded,
                      (unsigned long long)be.responded,
                      be.gen_known ? "true" : "false",
                      (unsigned long long)be.gen,
+                     remote_counts[i],
                      i + 1 < g_bal.backends.size() ? "," : "");
             out += line;
         }

@@ -62,13 +62,14 @@ async def start_balancer(sockdir, scan_ms=150, cache_ms=60000,
 
 
 async def udp_ask(port, name, qtype, qid=1, timeout=5.0, sock=None,
-                  host="127.0.0.1"):
+                  host="127.0.0.1", rd=False):
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
     class Proto(asyncio.DatagramProtocol):
         def connection_made(self, transport):
-            transport.sendto(make_query(name, qtype, qid=qid).encode())
+            transport.sendto(make_query(name, qtype, qid=qid,
+                                        rd=rd).encode())
 
         def datagram_received(self, data, addr):
             if not fut.done():

@@ -138,3 +138,84 @@ def test_zk_to_balancer_full_chain(tmp_path):
             await zkserver.stop()
 
     asyncio.run(run())
+
+
+def test_recursion_through_balancer_not_cached(tmp_path):
+    """Cross-DC recursion behind the balancer: answers forwarded from a
+    remote binder are served but carry the do-not-store marker, so the
+    balancer never caches another DC's data — a remote mutation is
+    visible on the very next query."""
+    from binder_tpu.recursion import Recursion, StaticResolverSource
+    from binder_tpu.store import FakeStore
+
+    sockdir = str(tmp_path)
+
+    async def run():
+        # remote DC binder (direct UDP, its own store)
+        rstore = FakeStore()
+        rcache = MirrorCache(rstore, DOMAIN)
+        rstore.put_json("/com/foo/east", {"type": "service",
+                                          "service": {"port": 53}})
+        rstore.put_json("/com/foo/east/web",
+                        {"type": "host",
+                         "host": {"address": "10.66.0.1"}})
+        rstore.start_session()
+        remote = BinderServer(zk_cache=rcache, dns_domain=DOMAIN,
+                              datacenter_name="east", host="127.0.0.1",
+                              port=0, collector=MetricsCollector())
+        await remote.start()
+
+        # local backend with recursion to the remote, behind the balancer
+        lstore = FakeStore()
+        lcache = MirrorCache(lstore, DOMAIN)
+        lstore.put_json("/com/foo/web",
+                        {"type": "host", "host": {"address": "10.1.0.1"}})
+        lstore.start_session()
+        recursion = Recursion(
+            zk_cache=lcache, dns_domain=DOMAIN, datacenter_name="local",
+            source=StaticResolverSource(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]}),
+            nic_provider=lambda: [])
+        await recursion.wait_ready()
+        local = BinderServer(zk_cache=lcache, dns_domain=DOMAIN,
+                             datacenter_name="local", recursion=recursion,
+                             host="127.0.0.1", port=0,
+                             balancer_socket=os.path.join(sockdir, "0"),
+                             collector=MetricsCollector())
+        await local.start()
+
+        proc, port = await start_balancer(sockdir)
+        try:
+            await asyncio.sleep(0.4)
+            # local name: cacheable as usual
+            for qid in (1, 2):
+                m = await udp_ask(port, "web.foo.com", Type.A, qid=qid)
+                assert m.answers[0].address == "10.1.0.1"
+            hits_after_local = read_stats(sockdir)["cache_hits"]
+            assert hits_after_local == 1
+
+            # remote-DC name with RD: forwarded every time, never cached
+            for qid in (10, 11, 12):
+                m = await udp_ask(port, "web.east.foo.com", Type.A,
+                                  qid=qid, rd=True)
+                assert m.rcode == Rcode.NOERROR
+                assert m.answers[0].address == "10.66.0.1"
+            stats = read_stats(sockdir)
+            assert stats["cache_hits"] == hits_after_local  # no new hits
+
+            # remote mutation is visible immediately (nothing cached the
+            # old answer anywhere on the local side)
+            rstore.put_json("/com/foo/east/web",
+                            {"type": "host",
+                             "host": {"address": "10.66.0.99"}})
+            m = await udp_ask(port, "web.east.foo.com", Type.A,
+                              qid=20, rd=True)
+            assert m.answers[0].address == "10.66.0.99"
+        finally:
+            proc.kill()
+            await proc.wait()
+            await local.stop()
+            await recursion.close()
+            await remote.stop()
+
+    asyncio.run(run())
