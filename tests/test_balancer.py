@@ -436,3 +436,28 @@ class TestBalancerV6:
                 await b1.stop()
 
         asyncio.run(run())
+
+    def test_cache_time_expiry_reforwards(self, tmp_path):
+        """Entries lapse after -c ms even with no store mutation."""
+        sockdir = str(tmp_path)
+
+        async def run():
+            b1 = await start_backend(sockdir, 5301, 1)
+            proc, port = await start_balancer(sockdir, cache_ms=150)
+            try:
+                await asyncio.sleep(0.4)
+                for qid in (1, 2):
+                    await udp_ask(port, "web.foo.com", Type.A, qid=qid)
+                stats = read_stats(sockdir)
+                assert stats["cache_hits"] == 1
+                assert stats["backends"][0]["forwarded"] == 1
+                await asyncio.sleep(0.3)   # past expiry
+                await udp_ask(port, "web.foo.com", Type.A, qid=3)
+                stats = read_stats(sockdir)
+                assert stats["backends"][0]["forwarded"] == 2
+            finally:
+                proc.kill()
+                await proc.wait()
+                await b1.stop()
+
+        asyncio.run(run())
