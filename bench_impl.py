@@ -18,6 +18,7 @@ import asyncio
 import json
 import os
 import re
+import select
 import subprocess
 import sys
 import tempfile
@@ -131,8 +132,11 @@ def start_server(tmpdir: str) -> subprocess.Popen:
         stderr=subprocess.DEVNULL)
 
 
-def wait_for_port(proc: subprocess.Popen) -> int:
-    import select
+def _wait_for_line(proc: subprocess.Popen, pattern: bytes,
+                   what: str) -> int:
+    """Deadline-bounded read of proc stdout until `pattern` matches;
+    returns the captured int.  A child that wedges mid-startup (or
+    writes a partial line) must not hang the bench."""
     deadline = time.time() + 30
     buf = b""
     while time.time() < deadline:
@@ -142,12 +146,20 @@ def wait_for_port(proc: subprocess.Popen) -> int:
             break
         chunk = os.read(proc.stdout.fileno(), 4096)
         if not chunk:
-            raise RuntimeError("bench server exited during startup")
+            raise RuntimeError("%s exited during startup" % what)
         buf += chunk
-        m = re.search(rb"UDP DNS service started on [\d.]+:(\d+)", buf)
+        m = re.search(pattern, buf)
         if m:
             return int(m.group(1))
-    raise RuntimeError("bench server did not report its port within 30s")
+    raise RuntimeError("%s did not report its port within 30s" % what)
+
+
+def wait_for_port(proc: subprocess.Popen) -> int:
+    # patterns must anchor past the number, or a mid-number pipe-buffer
+    # split ("...:444" / "28\"...") yields a truncated port; the bunyan
+    # msg is JSON, so the port is terminated by the closing quote
+    return _wait_for_line(
+        proc, rb"UDP DNS service started on [\d.]+:(\d+)\"", "bench server")
 
 
 async def _drive(port: int) -> Dict[str, float]:
@@ -257,8 +269,7 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
              "-s", "300"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
         procs.append(bal)
-        line = bal.stdout.readline()
-        port = int(line.split()[1])
+        port = _wait_for_line(bal, rb"PORT (\d+)\n", "mbalancer")
         time.sleep(0.5)   # backend scan + connect
         res = None
         for _ in range(2):   # pass 1 warms the balancer cache

@@ -257,8 +257,11 @@ class BinderServer:
         collectors (registered as a pre-scrape hook).  Deltas are taken
         against the last fold under a lock — concurrent scrapes must not
         double-count."""
-        stats = _fastio.fastpath_stats(self._fastpath)
         with self._fp_fold_lock:
+            # Snapshot inside the lock: with it outside, two concurrent
+            # scrapes could fold in order new-then-old, regressing the
+            # delta baseline and double-counting on the next fold.
+            stats = _fastio.fastpath_stats(self._fastpath)
             last = self._fp_folded
             hits_delta = stats["hits"] - last.get("hits", 0)
             if hits_delta > 0:

@@ -499,19 +499,38 @@ void backend_cache_clear(Backend &be) {
 
 void backend_cache_insert(Backend &be, const uint8_t *key, size_t keylen,
                           const uint8_t *wire, size_t len, bool rotatable) {
-    if (be.cache.size() >= kMaxCacheEntriesPerBackend ||
-        g_cache_bytes + len > kMaxCacheBytes) {
+    std::string mkey((const char *)key, keylen);
+    {
+        /* discard-before-evict: a late fill that will be thrown away
+         * must not trigger the budget eviction below (which could wipe
+         * another backend's entire hot cache for a 0-byte insert) */
+        auto it = be.cache.find(mkey);
+        if (it != be.cache.end() &&
+            (it->second.complete ||
+             it->second.wires.size() >= kCacheVariants))
+            return;   /* late fill from a pre-completion forward */
+    }
+    if (be.cache.size() >= kMaxCacheEntriesPerBackend) {
         /* bounded reset, like the affinity table: the cache is an
          * optimization, and a flood of distinct questions must not OOM */
         backend_cache_clear(be);
     }
-    std::string mkey((const char *)key, keylen);
-    CacheEntry &e = be.cache[mkey];
-    if (e.wires.empty()) {
-        e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
-    } else if (e.complete || e.wires.size() >= kCacheVariants) {
-        return;   /* late fill from a pre-completion forward */
+    while (g_cache_bytes + len > kMaxCacheBytes) {
+        /* The byte budget is global, so shed from whichever backend
+         * holds the most — clearing the *inserting* backend would let
+         * one dominant backend starve the others' (small) caches
+         * without ever bringing the total under the cap. */
+        Backend *fat = &be;
+        for (auto &other : g_bal.backends)
+            if (other.cache_bytes > fat->cache_bytes)
+                fat = &other;
+        if (fat->cache_bytes == 0)
+            break;                     /* len alone exceeds the budget */
+        backend_cache_clear(*fat);
     }
+    CacheEntry &e = be.cache[mkey];
+    if (e.wires.empty())
+        e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
     e.wires.emplace_back(wire, wire + len);
     e.bytes += len;
     g_cache_bytes += len;
@@ -600,8 +619,21 @@ void udp_out_flush() {
             continue;
         }
         if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK)
-            break;             /* socket buffer full: drop rest (UDP) */
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            /* socket buffer full: drop rest (UDP) */
+            g_bal.drops += (uint64_t)(g_udp_out.n - off);
+            break;
+        }
+        if (errno == EBADF || errno == ENOTSOCK || errno == EFAULT ||
+            errno == ENOMEM) {
+            /* batch-fatal, not per-destination (same policy as
+             * fastpath.c's hit flush): retrying datagram-by-datagram
+             * on a dead fd or OOM just spins 64 times */
+            g_bal.drops += (uint64_t)(g_udp_out.n - off);
+            logmsg("udp_out_flush: fatal sendmmsg errno %d", errno);
+            break;
+        }
+        g_bal.drops += 1;
         off += 1;              /* per-destination failure: skip one */
     }
     g_udp_out.n = 0;
