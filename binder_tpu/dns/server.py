@@ -82,10 +82,31 @@ def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
 
 
 class DnsServer:
+    #: Bounds for the TCP front (the reference's mname engine had none;
+    #: a DNS front end that one slow peer can fd-starve is not done).
+    #: Both are per-server and overridable at construction.
+    TCP_IDLE_TIMEOUT = 30.0    # seconds without a complete read
+    MAX_TCP_CONNS = 1024
+    MAX_TCP_WRITE_BUFFER = 256 * 1024   # bytes queued to one client
+
     def __init__(self, log: Optional[logging.Logger] = None,
-                 name: str = "binder") -> None:
+                 name: str = "binder",
+                 tcp_idle_timeout: Optional[float] = None,
+                 max_tcp_conns: Optional[int] = None,
+                 max_tcp_write_buffer: Optional[int] = None) -> None:
         self.log = log or logging.getLogger("binder.dns")
         self.name = name
+        self.tcp_idle_timeout = (self.TCP_IDLE_TIMEOUT
+                                 if tcp_idle_timeout is None
+                                 else tcp_idle_timeout)
+        self.max_tcp_conns = (self.MAX_TCP_CONNS if max_tcp_conns is None
+                              else max_tcp_conns)
+        self.max_tcp_write_buffer = (self.MAX_TCP_WRITE_BUFFER
+                                     if max_tcp_write_buffer is None
+                                     else max_tcp_write_buffer)
+        # TCP clients only (balancer links are trusted local peers and
+        # excluded from the cap/idle policy)
+        self._tcp_conns: set = set()
         self.on_query: Optional[Callable] = None   # async (QueryCtx) -> None
         self.on_after: Optional[Callable] = None   # sync  (QueryCtx) -> None
         self._udp_socks: List[tuple] = []   # (loop, socket)
@@ -410,21 +431,53 @@ class DnsServer:
     async def _tcp_conn(self, reader: asyncio.StreamReader,
                         writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername") or ("?", 0)
+        if len(self._tcp_conns) >= self.max_tcp_conns:
+            # at the connection cap: refuse the newcomer outright (the
+            # idle timeout below guarantees slots recycle, so a
+            # slowloris herd can't pin the front end shut for long)
+            self.log.warning("TCP connection cap (%d) reached, refusing "
+                             "%s", self.max_tcp_conns, peer[0])
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+            return
         self._conns.add(writer)
+        self._tcp_conns.add(writer)
         try:
             while True:
-                hdr = await reader.readexactly(2)
-                (length,) = struct.unpack(">H", hdr)
-                data = await reader.readexactly(length)
+                # the idle clock covers the whole frame: a client
+                # trickling one byte per timeout ("slowloris") gets the
+                # same deadline as a silent one
+                async with asyncio.timeout(self.tcp_idle_timeout or None):
+                    hdr = await reader.readexactly(2)
+                    (length,) = struct.unpack(">H", hdr)
+                    data = await reader.readexactly(length)
 
                 def send(wire: bytes) -> None:
+                    # responses are produced asynchronously, so the
+                    # write-buffer bound lives here: a client that asks
+                    # but never reads must cost O(cap), not OOM
+                    transport = writer.transport
+                    if (transport.get_write_buffer_size()
+                            > self.max_tcp_write_buffer):
+                        self.log.warning(
+                            "TCP client %s not reading responses "
+                            "(>%d bytes buffered), aborting", peer[0],
+                            self.max_tcp_write_buffer)
+                        transport.abort()
+                        return
                     writer.write(struct.pack(">H", len(wire)) + wire)
 
                 self._handle_raw(data, (peer[0], peer[1]), "tcp", send)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except TimeoutError:
+            self.log.debug("closing idle TCP connection from %s", peer[0])
         finally:
             self._conns.discard(writer)
+            self._tcp_conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()

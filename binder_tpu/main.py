@@ -134,6 +134,12 @@ async def run(options: Dict[str, object]) -> BinderServer:
         query_log=bool(options.get("queryLog", True)),
         cache_size=int(options.get("size", 10000)),
         cache_expiry_ms=int(options.get("expiry", 60000)),
+        tcp_idle_timeout=(float(options["tcpIdleTimeout"])
+                          if "tcpIdleTimeout" in options else None),
+        max_tcp_conns=(int(options["maxTcpConns"])
+                       if "maxTcpConns" in options else None),
+        max_tcp_write_buffer=(int(options["maxTcpWriteBuffer"])
+                              if "maxTcpWriteBuffer" in options else None),
     )
     await server.start()
     log.info("done with binder init")

@@ -68,6 +68,9 @@ class BinderServer:
                  query_log: bool = True,
                  cache_size: int = 10000,
                  cache_expiry_ms: int = 60000,
+                 tcp_idle_timeout: Optional[float] = None,
+                 max_tcp_conns: Optional[int] = None,
+                 max_tcp_write_buffer: Optional[int] = None,
                  probes: Optional[ProbeProvider] = None) -> None:
         self.log = log or logging.getLogger("binder.server")
         self.host = host
@@ -107,7 +110,10 @@ class BinderServer:
         self.resolver = Resolver(zk_cache, dns_domain=dns_domain,
                                  datacenter_name=datacenter_name,
                                  recursion=recursion, log=self.log)
-        self.engine = DnsServer(log=self.log, name=name)
+        self.engine = DnsServer(log=self.log, name=name,
+                                tcp_idle_timeout=tcp_idle_timeout,
+                                max_tcp_conns=max_tcp_conns,
+                                max_tcp_write_buffer=max_tcp_write_buffer)
         self.engine.on_query = self._on_query
         self.engine.on_after = self._on_after
 

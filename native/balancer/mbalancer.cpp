@@ -158,8 +158,13 @@ struct Stream {
     std::vector<uint8_t> rbuf;
     std::deque<std::vector<uint8_t>> wq;   /* pending writes */
     size_t wq_off = 0;                     /* offset into wq.front() */
+    size_t wq_bytes = 0;                   /* sum of queued buffers */
+    uint64_t flushed_total = 0;            /* lifetime bytes written */
 
-    void queue_write(std::vector<uint8_t> &&data) { wq.push_back(std::move(data)); }
+    void queue_write(std::vector<uint8_t> &&data) {
+        wq_bytes += data.size();
+        wq.push_back(std::move(data));
+    }
 
     /* Drain the queue with writev — under load many query frames are
      * queued per event-loop pass (see flush_pending_backends), and one
@@ -181,11 +186,13 @@ struct Stream {
                 if (errno == EINTR) continue;
                 return false;
             }
+            flushed_total += (uint64_t)n;
             size_t left = (size_t)n;
             while (left > 0) {
                 size_t avail = wq.front().size() - wq_off;
                 if (left >= avail) {
                     left -= avail;
+                    wq_bytes -= wq.front().size();
                     wq.pop_front();
                     wq_off = 0;
                 } else {
@@ -230,6 +237,8 @@ struct Backend {
     /* deferred-flush state (see flush_pending_backends) */
     bool flush_pending = false;
     size_t pending_queued = 0;
+    int stall_ticks = 0;       /* consecutive no-drain ticks at depth */
+    uint64_t last_flushed_total = 0;   /* drain progress marker */
     /* answer-cache invalidation state: the backend reports its mirror
      * generation over the socket (control frames); entries resolved
      * under an older generation are stale.  epoch distinguishes
@@ -244,10 +253,29 @@ struct Backend {
     uint64_t cache_bytes = 0;
 };
 
+/* ---- write-queue / connection bounds ----
+ * Everything facing a peer that can stop reading must be bounded:
+ * a stalled backend or slowloris TCP client must cost O(cap) memory
+ * and eventually lose its connection, never OOM the balancer.
+ * Defaults are production values; the env overrides exist so tests can
+ * trip the caps without shoving megabytes through loopback. */
+size_t g_max_backend_wq = 8u << 20;    /* per backend stream */
+size_t g_max_client_wq = 1u << 20;     /* per TCP client */
+constexpr int kBackendStallTicks = 3;  /* timer ticks at cap => down */
+constexpr double kEvictIdleFloorS = 1.0;  /* min idle before cap-evict */
+
+void load_bound_overrides() {
+    const char *s = getenv("MBALANCER_MAX_BACKEND_WQ");
+    if (s != nullptr && atol(s) > 0) g_max_backend_wq = (size_t)atol(s);
+    s = getenv("MBALANCER_MAX_CLIENT_WQ");
+    if (s != nullptr && atol(s) > 0) g_max_client_wq = (size_t)atol(s);
+}
+
 /* ---- TCP client connection state ---- */
 struct TcpClient {
     Stream conn;
     ClientKey key;
+    double last_active = 0;   /* mono_s() of last read/write progress */
 };
 
 struct Balancer {
@@ -256,6 +284,8 @@ struct Balancer {
     int port = 53;
     int scan_ms = 2000;
     int cache_ms = 60000;      /* answer-cache expiry; 0 disables */
+    int tcp_idle_ms = 30000;   /* idle TCP clients are evicted */
+    int max_tcp_clients = 1024;
 
     int epfd = -1;
     int udp_fd = -1;
@@ -273,6 +303,10 @@ struct Balancer {
 
     uint64_t udp_queries = 0, tcp_queries = 0, drops = 0;
     uint64_t cache_hits = 0;
+    uint64_t wq_overflows = 0;    /* frames refused: stream at byte cap */
+    uint64_t idle_closes = 0;     /* TCP clients evicted for idleness */
+    uint64_t client_evictions = 0; /* evicted to admit a new client */
+    uint64_t backend_stalls = 0;  /* backends downed for a stuck queue */
     uint64_t started_at = 0;
 };
 
@@ -325,6 +359,8 @@ void backend_mark_down(Backend &be) {
     }
     be.healthy = false;
     be.gen_known = false;
+    be.stall_ticks = 0;
+    be.last_flushed_total = 0;
     backend_cache_clear(be);   /* a restarted process restarts its gen */
 }
 
@@ -342,6 +378,8 @@ bool backend_connect(Backend &be) {
     }
     be.conn = Stream();
     be.conn.fd = fd;
+    be.stall_ticks = 0;
+    be.last_flushed_total = 0;
     be.healthy = true;   /* optimistic; demoted on first error */
     /* new process behind the same socket path: its generation counter
      * restarts, so retire every cache entry from the previous epoch */
@@ -393,6 +431,48 @@ void scan_sockdir() {
             logmsg("backend %d socket removed, draining", be.id);
             backend_mark_down(be);
         }
+    }
+}
+
+void tcp_client_close(int fd);   /* defined with the TCP front below */
+double mono_s();                 /* defined with the cache below */
+
+/* Periodic resource sweep (rides the sockdir-scan timer): evict TCP
+ * clients idle past the deadline, and mark down backends whose write
+ * queue has sat at the byte cap for kBackendStallTicks consecutive
+ * ticks — a backend that stopped reading is as dead as one that
+ * closed, it just fails slower. */
+void sweep_connections() {
+    double now = mono_s();
+    if (g_bal.tcp_idle_ms > 0) {   /* -T 0 disables, like -c 0 */
+        double idle_cutoff = now - (double)g_bal.tcp_idle_ms / 1000.0;
+        std::vector<int> idle;
+        for (const auto &p : g_bal.tcp_clients)
+            if (p.second.last_active < idle_cutoff)
+                idle.push_back(p.first);
+        for (int fd : idle) {
+            g_bal.idle_closes++;
+            tracemsg("closing idle TCP client fd %d", fd);
+            tcp_client_close(fd);
+        }
+    }
+    for (auto &be : g_bal.backends) {
+        if (be.conn.fd < 0) continue;
+        /* "stalled" = deep queue AND zero drain progress since the
+         * last tick — a saturated-but-draining backend (flushed_total
+         * advancing) is busy, not dead, and must stay in rotation */
+        if (be.conn.wq_bytes >= g_max_backend_wq / 2 &&
+            be.conn.flushed_total == be.last_flushed_total) {
+            if (++be.stall_ticks >= kBackendStallTicks) {
+                logmsg("backend %d stalled (%zu bytes queued, no drain), "
+                       "marking down", be.id, be.conn.wq_bytes);
+                g_bal.backend_stalls++;
+                backend_mark_down(be);
+            }
+        } else {
+            be.stall_ticks = 0;
+        }
+        be.last_flushed_total = be.conn.flushed_total;
     }
 }
 
@@ -548,6 +628,14 @@ std::vector<int> g_flush_pending;
 void forward_query_to(int idx, const ClientKey &client, uint8_t transport,
                       const uint8_t *payload, size_t len) {
     Backend &be = g_bal.backends[idx];
+    if (be.conn.wq_bytes >= g_max_backend_wq) {
+        /* backend not draining: shed this query (clients retry) rather
+         * than grow the queue without bound; a persistently stuck queue
+         * gets the backend marked down by the timer sweep */
+        g_bal.drops++;
+        g_bal.wq_overflows++;
+        return;
+    }
     be.conn.queue_write(make_frame(client, transport, payload, len));
     be.forwarded++;
     be.pending_queued++;
@@ -783,9 +871,35 @@ void handle_tcp_accept() {
         int fd = accept4(g_bal.tcp_fd, (struct sockaddr *)&ss, &slen,
                          SOCK_NONBLOCK);
         if (fd < 0) return;
+        if ((int)g_bal.tcp_clients.size() >= g_bal.max_tcp_clients) {
+            /* At the connection cap: evict the idlest client to admit
+             * the newcomer — but only one genuinely idle (past the
+             * floor).  Unconditional evict-idlest would let a cheap
+             * connect() flood displace every established client, since
+             * fresh attacker connections always carry newer activity
+             * stamps than the legitimate ones they evict. */
+            int idlest = -1;
+            double oldest = 1e300;
+            for (const auto &p : g_bal.tcp_clients) {
+                if (p.second.last_active < oldest) {
+                    oldest = p.second.last_active;
+                    idlest = p.first;
+                }
+            }
+            if (idlest >= 0 && mono_s() - oldest >= kEvictIdleFloorS) {
+                g_bal.client_evictions++;
+                tcp_client_close(idlest);
+            } else {
+                /* everyone is recently active (or cap is 0): refuse
+                 * the newcomer; idle-timeout sweeps recycle slots */
+                close(fd);
+                continue;
+            }
+        }
         TcpClient tc;
         tc.conn.fd = fd;
         tc.key = key_from_sockaddr(ss);
+        tc.last_active = mono_s();
         g_bal.tcp_clients[fd] = std::move(tc);
         g_bal.tcp_by_key[g_bal.tcp_clients[fd].key] = fd;
         epoll_add(fd, EPOLLIN, tag(KIND_TCP_CLIENT, fd));
@@ -806,6 +920,7 @@ void handle_tcp_client(int fd, uint32_t events) {
             tcp_client_close(fd);
             return;
         }
+        tc.last_active = mono_s();
         if (!tc.conn.want_write())
             epoll_mod(fd, EPOLLIN, tag(KIND_TCP_CLIENT, fd));
     }
@@ -825,6 +940,7 @@ void handle_tcp_client(int fd, uint32_t events) {
             tcp_client_close(fd);
             return;
         }
+        tc.last_active = mono_s();
         auto &rb = tc.conn.rbuf;
         rb.insert(rb.end(), buf, buf + n);
         /* RFC 1035 4.2.2 framing: u16 length + message */
@@ -934,6 +1050,13 @@ void route_response(uint8_t family, uint8_t transport,
             return;
         }
         TcpClient &tc = g_bal.tcp_clients[it->second];
+        if (tc.conn.wq_bytes >= g_max_client_wq) {
+            /* client asked but stopped reading answers: disconnect
+             * rather than buffer unboundedly */
+            g_bal.wq_overflows++;
+            tcp_client_close(it->second);
+            return;
+        }
         std::vector<uint8_t> out(2 + len);
         out[0] = (uint8_t)(len >> 8);
         out[1] = (uint8_t)(len & 0xff);
@@ -1048,6 +1171,10 @@ void handle_stats() {
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
                  "  \"cache_hits\": %llu,\n  \"cache_entries\": %zu,\n"
+                 "  \"tcp_clients\": %zu,\n  \"wq_overflows\": %llu,\n"
+                 "  \"idle_closes\": %llu,\n"
+                 "  \"client_evictions\": %llu,\n"
+                 "  \"backend_stalls\": %llu,\n"
                  "  \"remotes\": %zu,\n  \"backends\": [\n",
                  (unsigned long long)(now_ms() - g_bal.started_at),
                  (unsigned long long)g_bal.udp_queries,
@@ -1058,6 +1185,11 @@ void handle_stats() {
                       for (const auto &b : g_bal.backends)
                           n += b.cache.size();
                       return n; }(),
+                 g_bal.tcp_clients.size(),
+                 (unsigned long long)g_bal.wq_overflows,
+                 (unsigned long long)g_bal.idle_closes,
+                 (unsigned long long)g_bal.client_evictions,
+                 (unsigned long long)g_bal.backend_stalls,
                  g_bal.remotes.size());
         out += line;
         /* one pass over the affinity map (reference be_remotes), not
@@ -1074,12 +1206,14 @@ void handle_stats() {
                      "    {\"id\": %d, \"path\": \"%s\", \"healthy\": %s, "
                      "\"forwarded\": %llu, \"responded\": %llu, "
                      "\"gen_known\": %s, \"gen\": %llu, "
+                     "\"wq_bytes\": %zu, "
                      "\"remotes\": %zu}%s\n",
                      be.id, be.path.c_str(), be.healthy ? "true" : "false",
                      (unsigned long long)be.forwarded,
                      (unsigned long long)be.responded,
                      be.gen_known ? "true" : "false",
                      (unsigned long long)be.gen,
+                     be.conn.wq_bytes,
                      remote_counts[i],
                      i + 1 < g_bal.backends.size() ? "," : "");
             out += line;
@@ -1181,17 +1315,20 @@ void report_port() {
 
 int main(int argc, char **argv) {
     int c;
-    while ((c = getopt(argc, argv, "d:p:b:s:c:v")) != -1) {
+    while ((c = getopt(argc, argv, "d:p:b:s:c:T:m:v")) != -1) {
         switch (c) {
         case 'd': g_bal.sockdir = optarg; break;
         case 'p': g_bal.port = atoi(optarg); break;
         case 'b': g_bal.bind_addr = optarg; break;
         case 's': g_bal.scan_ms = atoi(optarg); break;
         case 'c': g_bal.cache_ms = atoi(optarg); break;
+        case 'T': g_bal.tcp_idle_ms = atoi(optarg); break;
+        case 'm': g_bal.max_tcp_clients = atoi(optarg); break;
         case 'v': g_verbose = 1; break;
         default:
             fprintf(stderr, "usage: mbalancer -d sockdir [-p port] "
                             "[-b bindaddr] [-s scan_ms] [-c cache_ms] "
+                            "[-T tcp_idle_ms] [-m max_tcp_clients] "
                             "[-v]\n");
             return 1;
         }
@@ -1201,6 +1338,7 @@ int main(int argc, char **argv) {
         return 1;
     }
     signal(SIGPIPE, SIG_IGN);
+    load_bound_overrides();
     g_bal.started_at = now_ms();
 
     g_bal.epfd = epoll_create1(0);
@@ -1260,6 +1398,7 @@ int main(int argc, char **argv) {
                 uint64_t expirations;
                 while (read(g_bal.timer_fd, &expirations, 8) == 8) {}
                 scan_sockdir();
+                sweep_connections();
                 break;
             }
             }
