@@ -17,6 +17,7 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 from binder_tpu.dns.wire import Message, Rcode, Record, make_query
+from binder_tpu.utils.endpoints import parse_endpoint
 
 DEFAULT_TIMEOUT = 3.0  # lib/recursion.js:257
 
@@ -26,13 +27,7 @@ class UpstreamError(Exception):
 
 
 def _parse_resolver(r: str) -> Tuple[str, int]:
-    if r.startswith("["):  # [v6]:port
-        host, _, port = r[1:].partition("]:")
-        return host, int(port or 53)
-    if r.count(":") == 1:
-        host, _, port = r.partition(":")
-        return host, int(port)
-    return r, 53
+    return parse_endpoint(r, 53)
 
 
 class DnsClient:
@@ -79,17 +74,30 @@ class DnsClient:
                         # the lookup forever
                         errors.append(f"{resolver}: {e}")
                     else:
-                        if msg.rcode == Rcode.NOERROR and not msg.tc:
+                        if msg.rcode == Rcode.NOERROR and msg.tc:
+                            # truncated: retry the same resolver over
+                            # TCP before counting it as a failure
+                            # (mname-client capability the reference
+                            # relies on for large PTR/SRV answer sets,
+                            # lib/recursion.js:253-279)
+                            try:
+                                msg = await self._query_one_tcp(
+                                    name, qtype, resolver)
+                            except Exception as e:  # noqa: BLE001
+                                errors.append(
+                                    f"{resolver}: tcp retry: {e}")
+                                msg = None
+                        if (msg is not None
+                                and msg.rcode == Rcode.NOERROR
+                                and not msg.tc):
                             if not winner.done():
                                 winner.set_result(msg.answers)
                             return
-                        # truncated responses must not win with an empty
-                        # answer set; treat as upstream failure (a TCP
-                        # retry path is the eventual fix)
-                        errors.append(
-                            f"{resolver}: "
-                            + ("truncated" if msg.tc
-                               else f"rcode {Rcode.name(msg.rcode)}"))
+                        if msg is not None:
+                            errors.append(
+                                f"{resolver}: "
+                                + ("truncated" if msg.tc
+                                   else f"rcode {Rcode.name(msg.rcode)}"))
                     if len(errors) >= threshold and not winner.done():
                         winner.set_exception(UpstreamError(
                             "; ".join(errors[-4:])))
@@ -143,6 +151,34 @@ class DnsClient:
             return await asyncio.wait_for(fut, self.timeout)
         finally:
             transport.close()
+
+    async def _query_one_tcp(self, name: str, qtype: int,
+                             resolver: str) -> Message:
+        """RFC 1035 §4.2.2 framed query — the truncation fallback."""
+        host, port = _parse_resolver(resolver)
+        qid = random.randrange(0, 65536)
+        query = make_query(name, qtype, qid=qid, rd=False)
+        wire = query.encode()
+
+        async def go() -> Message:
+            reader, writer = await asyncio.open_connection(host, port)
+            try:
+                writer.write(len(wire).to_bytes(2, "big") + wire)
+                await writer.drain()
+                hdr = await reader.readexactly(2)
+                n = int.from_bytes(hdr, "big")
+                msg = Message.decode(await reader.readexactly(n))
+                if msg.id != qid:
+                    raise WireTimeout("upstream TCP answer id mismatch")
+                return msg
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+
+        return await asyncio.wait_for(go(), self.timeout)
 
 
 class WireTimeout(Exception):

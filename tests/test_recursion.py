@@ -58,13 +58,14 @@ async def start_local(dcs, **rkw):
     return server, recursion
 
 
-async def udp_ask(port, name, qtype, rd=True, timeout=5.0):
+async def udp_ask(port, name, qtype, rd=True, timeout=5.0, payload=1232):
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
     class Proto(asyncio.DatagramProtocol):
         def connection_made(self, transport):
-            transport.sendto(make_query(name, qtype, qid=3, rd=rd).encode())
+            transport.sendto(make_query(name, qtype, qid=3, rd=rd,
+                                        edns_payload=payload).encode())
 
         def datagram_received(self, data, addr):
             if not fut.done():
@@ -273,8 +274,18 @@ class TestReviewRegressions:
         assert _host_of("10.0.0.1:53") == "10.0.0.1"
         assert _host_of("10.0.0.1") == "10.0.0.1"
 
-    def test_truncated_upstream_counts_as_failure(self):
-        """A TC=1 NOERROR response must not win with an empty answer set."""
+    # (the truncated-upstream-counts-as-failure case moved to
+    # TestTcpFallback below, where tc=1 now triggers a TCP retry first)
+
+
+class TestTcpFallback:
+    """tc=1 upstream answers must be retried over TCP, not counted as
+    failures (VERDICT r1 item 3; reference capability
+    lib/recursion.js:253-279 via mname-client)."""
+
+    def test_truncating_udp_only_upstream_still_fails(self):
+        """No TCP listener behind the resolver: the TCP retry fails and
+        the upstream counts against the threshold (no hang, no win)."""
         async def run():
             from binder_tpu.recursion import DnsClient, UpstreamError
             loop = asyncio.get_running_loop()
@@ -303,4 +314,48 @@ class TestReviewRegressions:
             return None
 
         err = asyncio.run(run())
-        assert err is not None and "truncated" in err
+        assert err is not None and "tcp retry" in err
+
+    def test_large_answer_set_resolves_via_tcp(self):
+        """End to end: a remote DC whose answer set overflows the 1232-
+        byte EDNS ceiling truncates over UDP; the recursion client must
+        fetch the full set over TCP and the local binder must serve it."""
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/dc9", {"type": "service",
+                                            "service": {"port": 53}})
+            store.put_json("/com/foo/dc9/big", {
+                "type": "service",
+                "service": {"srvce": "_big", "proto": "_tcp", "port": 80},
+            })
+            for i in range(100):
+                store.put_json(f"/com/foo/dc9/big/lb{i}",
+                               {"type": "load_balancer",
+                                "load_balancer":
+                                    {"address": f"10.9.{i // 250}.{i % 250 + 1}"}})
+            store.start_session()
+            remote = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="dc9",
+                                  host="127.0.0.1", port=0,
+                                  collector=MetricsCollector())
+            await remote.start()
+            local, recursion = await start_local(
+                {"dc9": [f"127.0.0.1:{remote.udp_port}"]})
+            try:
+                # sanity: the remote really does truncate this over UDP
+                direct = await udp_ask(remote.udp_port, "big.dc9.foo.com",
+                                       Type.A)
+                assert direct.tc and not direct.answers
+                r = await udp_ask(local.udp_port, "big.dc9.foo.com",
+                                  Type.A, rd=True, payload=4096)
+            finally:
+                await local.stop()
+                await remote.stop()
+            return r
+
+        r = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert len(r.answers) == 100
+        addrs = {a.address for a in r.answers}
+        assert len(addrs) == 100
