@@ -34,8 +34,14 @@ from typing import Callable, Dict, List, Optional
 from binder_tpu.store import jute
 from binder_tpu.store.interface import StoreClient, Watcher
 from binder_tpu.store.jute import Buf, Err, EventType, OpCode
+from binder_tpu.utils.endpoints import parse_endpoint
 
 RECONNECT_DELAY = 1.0
+# Connect attempts must be bounded well under the session timeout: a
+# blackholed ensemble member (SYNs dropped, no RST) would otherwise
+# stall rotation for the kernel's ~2 min connect timeout while the
+# session expires.
+CONNECT_TIMEOUT = 3.0
 
 
 class _ZKWatcher(Watcher):
@@ -50,6 +56,20 @@ class _ZKWatcher(Watcher):
         self._client._schedule_sync(self.path, event)
 
 
+def parse_connect_string(address: str, default_port: int
+                         ) -> List[tuple]:
+    """``"h1,h2:2182,[::1]:2183"`` → ``[(h1, dp), (h2, 2182), (::1, 2183)]``.
+
+    The multi-host connect string is standard ZooKeeper client surface
+    (production binder co-locates with a 3-5 node ensemble,
+    reference README.md:36-39); each entry may carry its own port."""
+    servers = [parse_endpoint(entry, default_port)
+               for entry in address.split(",") if entry.strip()]
+    if not servers:
+        raise ValueError(f"empty ZooKeeper connect string: {address!r}")
+    return servers
+
+
 class ZKClient(StoreClient):
     def __init__(self, address: str = "127.0.0.1", port: int = 2181,
                  session_timeout_ms: int = 30000,
@@ -57,6 +77,11 @@ class ZKClient(StoreClient):
                  collector=None) -> None:
         self.address = address
         self.port = port
+        # ensemble rotation state: reconnects walk the server list round-
+        # robin, so losing one server fails over to the next (the session,
+        # replicated by ZAB, survives the move)
+        self._servers = parse_connect_string(address, port)
+        self._server_idx = 0
         self.session_timeout_ms = session_timeout_ms
         self.log = log or logging.getLogger("binder.zk")
 
@@ -148,13 +173,18 @@ class ZKClient(StoreClient):
             except Exception as e:  # noqa: BLE001
                 self.log.warning("zk: session error: %s", e)
             self._connected = False
+            # whatever ended the session, try the next ensemble member
+            # (reconnecting straight back to a dead server would burn a
+            # full RECONNECT_DELAY cycle per retry)
+            self._server_idx = (self._server_idx + 1) % len(self._servers)
             if self._closed:
                 return
             await asyncio.sleep(RECONNECT_DELAY)
 
     async def _run_session(self) -> None:
-        reader, writer = await asyncio.open_connection(self.address,
-                                                       self.port)
+        host, port = self._servers[self._server_idx]
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), CONNECT_TIMEOUT)
         self._writer = writer
         try:
             # ConnectRequest: protoVer, lastZxidSeen, timeout, sessionId,

@@ -66,12 +66,16 @@ class ZKTestServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        # sever live connections BEFORE wait_closed(): since 3.12 it
+        # waits for connection handlers too, and a handler blocked in a
+        # read only exits once its writer (same transport) is closed —
+        # the old order deadlocked when a client was still connected
         for s in self._sessions.values():
             if s.writer is not None:
                 s.writer.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
 
     def expire_session(self, session_id: Optional[int] = None) -> None:
         """Mark session(s) expired and drop their connections — the test
