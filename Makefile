@@ -14,12 +14,16 @@ test: native
 	$(PY) -m pytest tests/ -q
 
 # style/consistency gate (the reference's `make check` runs jsstyle/jsl;
-# here: byte-compile everything and keep the native build warning-clean)
+# here: byte-compile everything, keep the native build warning-clean
+# (-B: a stale object must not mask a warning), and smoke the
+# sanitizer-built fuzzers over the native parsers)
 check:
 	$(PY) -m compileall -q binder_tpu tests bench.py bench_impl.py \
 		__graft_entry__.py
-	$(MAKE) -C native CXXFLAGS="-O2 -g -Wall -Wextra -Werror -std=c++17" \
+	$(MAKE) -B -C native \
+		CXXFLAGS="-O2 -g -Wall -Wextra -Werror -std=c++17" \
 		CFLAGS="-O2 -g -Wall -Wextra -Werror"
+	$(MAKE) -C native fuzz-smoke
 
 bench: native
 	$(PY) bench.py

@@ -1072,6 +1072,64 @@ void route_response(uint8_t family, uint8_t transport,
     }
 }
 
+/* Append `n` bytes from a backend connection to its stream buffer and
+ * walk the complete frames in it.  Returns false on a protocol error
+ * (caller marks the backend down).  Split out of handle_backend so the
+ * frame parser can be driven directly with hostile bytes (fuzz target
+ * native/fuzz/fuzz_frames.cpp). */
+bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
+    auto &rb = be.conn.rbuf;
+    rb.insert(rb.end(), buf, buf + n);
+    size_t off = 0;
+    bool ok = true;
+    while (rb.size() - off >= 4) {
+        uint32_t L;
+        memcpy(&L, rb.data() + off, 4);
+        L = ntohl(L);
+        if (L < kFrameHdr || L > kMaxFrame) {
+            logmsg("backend %d protocol error (frame len %u)", be.id, L);
+            ok = false;
+            break;
+        }
+        if (rb.size() - off - 4 < L) break;
+        const uint8_t *f = rb.data() + off + 4;
+        if (f[0] != kProtoVersion) {
+            logmsg("backend %d protocol version %u", be.id, f[0]);
+            ok = false;
+            break;
+        }
+        if (f[1] == 0) {
+            /* control frame; opcode in the transport byte.  0 =
+             * generation report: 8 bytes BE in the address field */
+            if (f[2] == 0 && L >= kFrameHdr) {
+                uint64_t g = 0;
+                for (int b = 0; b < 8; b++)
+                    g = (g << 8) | f[3 + b];
+                if (!be.gen_known || be.gen != g)
+                    backend_cache_clear(be);   /* all entries stale */
+                be.gen = g;
+                be.gen_known = true;
+            }
+            off += 4 + L;
+            continue;
+        }
+        uint16_t port = (uint16_t)((f[19] << 8) | f[20]);
+        be.responded++;
+        if (g_bal.cache_ms > 0 && f[2] == kTransportUdp)
+            maybe_cache_fill(be, f[1], f + 3, port, f + kFrameHdr,
+                             L - kFrameHdr);
+        uint8_t transport = f[2] == kTransportUdpNoStore
+            ? kTransportUdp : f[2];
+        route_response(f[1], transport, f + 3, port, f + kFrameHdr,
+                       L - kFrameHdr);
+        off += 4 + L;
+    }
+    /* batched UDP responses reference rb — flush before it mutates */
+    udp_out_flush();
+    if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
+    return ok;
+}
+
 void handle_backend(int fd, uint32_t events) {
     auto it = g_bal.backend_by_fd.find(fd);
     if (it == g_bal.backend_by_fd.end()) return;
@@ -1106,56 +1164,10 @@ void handle_backend(int fd, uint32_t events) {
             backend_mark_down(be);
             return;
         }
-        auto &rb = be.conn.rbuf;
-        rb.insert(rb.end(), buf, buf + n);
-        size_t off = 0;
-        while (rb.size() - off >= 4) {
-            uint32_t L;
-            memcpy(&L, rb.data() + off, 4);
-            L = ntohl(L);
-            if (L < kFrameHdr || L > kMaxFrame) {
-                logmsg("backend %d protocol error (frame len %u)", be.id, L);
-                udp_out_flush();
-                backend_mark_down(be);
-                return;
-            }
-            if (rb.size() - off - 4 < L) break;
-            const uint8_t *f = rb.data() + off + 4;
-            if (f[0] != kProtoVersion) {
-                logmsg("backend %d protocol version %u", be.id, f[0]);
-                udp_out_flush();
-                backend_mark_down(be);
-                return;
-            }
-            if (f[1] == 0) {
-                /* control frame; opcode in the transport byte.  0 =
-                 * generation report: 8 bytes BE in the address field */
-                if (f[2] == 0 && L >= kFrameHdr) {
-                    uint64_t g = 0;
-                    for (int b = 0; b < 8; b++)
-                        g = (g << 8) | f[3 + b];
-                    if (!be.gen_known || be.gen != g)
-                        backend_cache_clear(be);   /* all entries stale */
-                    be.gen = g;
-                    be.gen_known = true;
-                }
-                off += 4 + L;
-                continue;
-            }
-            uint16_t port = (uint16_t)((f[19] << 8) | f[20]);
-            be.responded++;
-            if (g_bal.cache_ms > 0 && f[2] == kTransportUdp)
-                maybe_cache_fill(be, f[1], f + 3, port, f + kFrameHdr,
-                                 L - kFrameHdr);
-            uint8_t transport = f[2] == kTransportUdpNoStore
-                ? kTransportUdp : f[2];
-            route_response(f[1], transport, f + 3, port, f + kFrameHdr,
-                           L - kFrameHdr);
-            off += 4 + L;
+        if (!backend_consume(be, buf, (size_t)n)) {
+            backend_mark_down(be);
+            return;
         }
-        /* batched UDP responses reference rb — flush before it mutates */
-        udp_out_flush();
-        if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
     }
 }
 

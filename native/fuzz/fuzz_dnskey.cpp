@@ -1,0 +1,59 @@
+/*
+ * Fuzz target: the shared DNS question-key builder (common/dnskey.h),
+ * the parser every hostile UDP packet hits first on the fast path
+ * (native/fastio/fastpath.c) and in the balancer cache.
+ *
+ * Beyond memory safety (ASan/UBSan), asserts the key-layout invariants
+ * the consumers rely on: bounded key length, name length consistency,
+ * lowercased charset-restricted name bytes.
+ */
+#include <assert.h>
+
+#include "../common/dnskey.h"
+#include "fuzz_util.h"
+
+void fuzz_setup() {}
+
+void fuzz_one(const uint8_t *data, size_t len) {
+    uint8_t key[DNSKEY_MAX];
+    /* canary beyond the documented max: the builder must never write
+     * past DNSKEY_MAX even for hostile input */
+    uint8_t guarded[DNSKEY_MAX + 8];
+    memset(guarded, 0xA5, sizeof(guarded));
+    size_t qn_len = 0;
+    uint16_t qtype = 0;
+    size_t klen = dnskey_build(data, len, guarded, &qn_len, &qtype);
+    for (int i = 0; i < 8; i++)
+        assert(guarded[DNSKEY_MAX + i] == 0xA5);
+    if (klen == 0)
+        return;                       /* not eligible: fine */
+    assert(klen >= 8 && klen <= DNSKEY_MAX);
+    assert(qn_len >= 1 && qn_len <= 256);
+    assert(klen == 7 + qn_len);
+    /* qname: well-formed label sequence, lowercase charset */
+    const uint8_t *kn = guarded + 7;
+    size_t off = 0;
+    for (;;) {
+        assert(off < qn_len);
+        uint8_t l = kn[off];
+        if (l == 0) {
+            assert(off + 1 == qn_len);
+            break;
+        }
+        assert((l & 0xC0) == 0);
+        for (uint8_t i = 1; i <= l; i++) {
+            uint8_t ch = kn[off + i];
+            assert(dnskey_name_ok(ch));
+            assert(!(ch >= 'A' && ch <= 'Z'));
+        }
+        off += 1 + (size_t)l;
+    }
+    /* determinism: same input -> same key */
+    size_t qn2 = 0;
+    uint16_t qt2 = 0;
+    size_t k2 = dnskey_build(data, len, key, &qn2, &qt2);
+    assert(k2 == klen && qn2 == qn_len && qt2 == qtype);
+    assert(memcmp(key, guarded, klen) == 0);
+}
+
+int main(int argc, char **argv) { return fuzz::run(argc, argv); }

@@ -1,0 +1,128 @@
+/*
+ * Fuzz target: the balancer's backend frame parser and the answer-cache
+ * fill path behind it (backend_consume -> maybe_cache_fill ->
+ * response_matches_key -> backend_cache_insert -> route_response).
+ *
+ * Includes mbalancer.cpp directly (its internals live in an anonymous
+ * namespace) with main() renamed away.  Two modes per input:
+ *  - raw: the bytes are the stream, exercising framing/resync;
+ *  - wrapped: the bytes become the payload of a well-formed data frame
+ *    addressed at a planted pending-fill slot, exercising the response
+ *    matcher and cache insert deep paths.
+ */
+#define main mbalancer_main_unused
+#include "../balancer/mbalancer.cpp"
+#undef main
+
+#include <assert.h>
+
+#include "fuzz_util.h"
+
+namespace {
+
+Backend *fz_be = nullptr;
+uint64_t fz_iter = 0;
+
+/* a canned well-formed query for planting pending fills */
+const uint8_t kQuery[] = {
+    0x12, 0x34, 0x01, 0x00,              /* id, RD query */
+    0x00, 0x01, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+    0x03, 'w', 'e', 'b', 0x03, 'f', 'o', 'o', 0x03, 'c', 'o', 'm', 0x00,
+    0x00, 0x01, 0x00, 0x01,              /* A IN */
+};
+
+void plant_pending(const ClientKey &ck, uint16_t qid) {
+    uint8_t key[DNSKEY_MAX];
+    size_t qn_len = 0;
+    uint16_t qtype = 0;
+    size_t klen = dnskey_build(kQuery, sizeof(kQuery), key, &qn_len,
+                               &qtype);
+    assert(klen > 0 && klen <= DNSKEY_MAX);
+    PendingFill &pf = g_pending_fill[pending_slot(ck, qid)];
+    pf.client = ck;
+    pf.qid = qid;
+    pf.keylen = (uint16_t)klen;
+    pf.backend_id = fz_be->id;
+    pf.epoch = fz_be->epoch;
+    pf.used = true;
+    memcpy(pf.key, key, klen);
+}
+
+}  // namespace
+
+void fuzz_setup() {
+    /* logmsg() fires per protocol error — i.e. on most mutated inputs;
+     * success is the exit code */
+    int devnull = open("/dev/null", O_WRONLY);
+    if (devnull >= 0) {
+        dup2(devnull, 2);
+        close(devnull);
+    }
+    g_bal.cache_ms = 60000;            /* enable the cache fill path */
+    g_bal.udp_fd = -1;                 /* sends fail fast (EBADF) */
+    Backend be;
+    be.id = 0;
+    be.path = "/nonexistent/fuzz";
+    be.conn.fd = -1;
+    be.healthy = true;
+    g_bal.backends.push_back(std::move(be));
+    fz_be = &g_bal.backends[0];
+}
+
+void fuzz_one(const uint8_t *data, size_t len) {
+    fz_iter++;
+    Backend &be = *fz_be;
+
+    /* periodically refresh generation state so both the gen-known and
+     * gen-unknown fill paths run */
+    if (fz_iter % 3 == 0) {
+        be.gen = fz_iter;
+        be.gen_known = true;
+    } else if (fz_iter % 7 == 0) {
+        be.gen_known = false;
+    }
+
+    if (fz_iter % 2 == 0) {
+        /* raw stream bytes */
+        (void)backend_consume(be, data, len);
+    } else {
+        /* wrap as a valid data frame addressed at a planted pending
+         * fill: version 1, family 4, transport UDP, addr+port, payload */
+        ClientKey ck{};
+        ck.family = 4;
+        ck.addr[0] = 127; ck.addr[3] = 1;
+        ck.port = 5353;
+        uint16_t qid = len >= 2 ? dnskey_rd16(data) : 0;
+        plant_pending(ck, qid);
+
+        size_t plen = len > kMaxFrame - kFrameHdr
+            ? kMaxFrame - kFrameHdr : len;
+        std::vector<uint8_t> frame(4 + kFrameHdr + plen);
+        uint32_t L = htonl((uint32_t)(kFrameHdr + plen));
+        memcpy(frame.data(), &L, 4);
+        frame[4] = kProtoVersion;
+        frame[5] = 4;                      /* family */
+        frame[6] = kTransportUdp;
+        memcpy(frame.data() + 7, ck.addr, 16);
+        frame[23] = (uint8_t)(ck.port >> 8);
+        frame[24] = (uint8_t)(ck.port & 0xff);
+        memcpy(frame.data() + 4 + kFrameHdr, data, plen);
+        (void)backend_consume(be, frame.data(), frame.size());
+    }
+
+    /* keep cross-iteration state bounded so the fuzzer's memory stays
+     * flat (the production caps are exercised, not relied on here) */
+    if (be.conn.rbuf.size() > 4 * kMaxFrame)
+        be.conn.rbuf.clear();
+    if (be.cache_bytes > (8u << 20) || be.cache.size() > 10000)
+        backend_cache_clear(be);
+    if (fz_iter % 4096 == 0)
+        for (auto &pf : g_pending_fill)
+            pf = PendingFill();
+    /* accounting invariants must hold whatever the input was */
+    assert(g_cache_bytes >= be.cache_bytes);
+    if (g_bal.backends.size() == 1)
+        assert(g_cache_bytes == be.cache_bytes);
+}
+
+int main(int argc, char **argv) { return fuzz::run(argc, argv); }

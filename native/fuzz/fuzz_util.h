@@ -1,0 +1,158 @@
+/*
+ * Deterministic mutational fuzz driver (shared by the fuzz_* targets).
+ *
+ * clang/libFuzzer is not in this image, so this is a self-contained
+ * substitute: load a seed corpus, then for N iterations pick a seed,
+ * apply a random stack of structure-blind mutations (bit flips, byte
+ * sets, truncations, extensions, splices, interesting values), and hand
+ * the result to the target's fuzz_one().  The PRNG is seeded from argv
+ * (default 1), so every run is reproducible; build with
+ * -fsanitize=address,undefined so any memory/UB finding aborts loudly.
+ *
+ * Usage: fuzz_<target> <corpus_dir> [iterations] [seed]
+ */
+#ifndef BINDER_FUZZ_UTIL_H
+#define BINDER_FUZZ_UTIL_H
+
+#include <dirent.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+/* target-provided; must tolerate arbitrary bytes without crashing */
+void fuzz_one(const uint8_t *data, size_t len);
+/* optional per-target setup before the loop */
+void fuzz_setup();
+
+namespace fuzz {
+
+struct Rng {
+    uint64_t s;
+    explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ULL) {}
+    uint64_t next() {
+        s ^= s << 13;
+        s ^= s >> 7;
+        s ^= s << 17;
+        return s;
+    }
+    uint32_t below(uint32_t n) { return n ? (uint32_t)(next() % n) : 0; }
+};
+
+using Corpus = std::vector<std::vector<uint8_t>>;
+
+inline Corpus load_corpus(const char *dir) {
+    Corpus corpus;
+    DIR *d = opendir(dir);
+    if (d == nullptr) {
+        fprintf(stderr, "fuzz: cannot open corpus dir %s\n", dir);
+        exit(2);
+    }
+    struct dirent *de;
+    while ((de = readdir(d)) != nullptr) {
+        if (de->d_name[0] == '.') continue;
+        std::string path = std::string(dir) + "/" + de->d_name;
+        FILE *fp = fopen(path.c_str(), "rb");
+        if (fp == nullptr) continue;
+        std::vector<uint8_t> buf;
+        uint8_t tmp[4096];
+        size_t n;
+        while ((n = fread(tmp, 1, sizeof(tmp), fp)) > 0)
+            buf.insert(buf.end(), tmp, tmp + n);
+        fclose(fp);
+        corpus.push_back(std::move(buf));
+    }
+    closedir(d);
+    if (corpus.empty()) {
+        fprintf(stderr, "fuzz: empty corpus in %s\n", dir);
+        exit(2);
+    }
+    return corpus;
+}
+
+inline void mutate(std::vector<uint8_t> &b, Rng &rng, const Corpus &corpus) {
+    static const uint8_t interesting[] = {0x00, 0x01, 0x7f, 0x80, 0xc0,
+                                          0xff, 0x29, 0x35};
+    int ops = 1 + (int)rng.below(8);
+    for (int i = 0; i < ops; i++) {
+        switch (rng.below(7)) {
+        case 0:   /* bit flip */
+            if (!b.empty())
+                b[rng.below((uint32_t)b.size())] ^=
+                    (uint8_t)(1u << rng.below(8));
+            break;
+        case 1:   /* set byte to interesting value */
+            if (!b.empty())
+                b[rng.below((uint32_t)b.size())] =
+                    interesting[rng.below(sizeof(interesting))];
+            break;
+        case 2:   /* random byte */
+            if (!b.empty())
+                b[rng.below((uint32_t)b.size())] = (uint8_t)rng.next();
+            break;
+        case 3:   /* truncate */
+            if (!b.empty())
+                b.resize(rng.below((uint32_t)b.size() + 1));
+            break;
+        case 4: { /* extend with random bytes */
+            uint32_t n = 1 + rng.below(32);
+            for (uint32_t k = 0; k < n; k++)
+                b.push_back((uint8_t)rng.next());
+            break;
+        }
+        case 5: { /* splice a chunk of another corpus entry */
+            const auto &other = corpus[rng.below((uint32_t)corpus.size())];
+            if (other.empty()) break;
+            uint32_t from = rng.below((uint32_t)other.size());
+            uint32_t n = 1 + rng.below((uint32_t)(other.size() - from));
+            uint32_t at = b.empty() ? 0 : rng.below((uint32_t)b.size());
+            b.insert(b.begin() + at, other.begin() + from,
+                     other.begin() + from + n);
+            break;
+        }
+        case 6: { /* overwrite a 2-byte BE length-looking field */
+            if (b.size() < 2) break;
+            uint32_t at = rng.below((uint32_t)b.size() - 1);
+            uint16_t v = (uint16_t)rng.next();
+            b[at] = (uint8_t)(v >> 8);
+            b[at + 1] = (uint8_t)v;
+            break;
+        }
+        }
+        if (b.size() > 70000) b.resize(70000);   /* frame-ish ceiling */
+    }
+}
+
+inline int run(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <corpus_dir> [iterations] [seed]\n",
+                argv[0]);
+        return 2;
+    }
+    long iters = argc > 2 ? atol(argv[2]) : 50000;
+    uint64_t seed = argc > 3 ? strtoull(argv[3], nullptr, 0) : 1;
+    Corpus corpus = load_corpus(argv[1]);
+    Rng rng(seed);
+    fuzz_setup();
+
+    /* every seed verbatim first: the corpus must never regress */
+    for (const auto &c : corpus)
+        fuzz_one(c.data(), c.size());
+
+    std::vector<uint8_t> buf;
+    for (long i = 0; i < iters; i++) {
+        buf = corpus[rng.below((uint32_t)corpus.size())];
+        mutate(buf, rng, corpus);
+        fuzz_one(buf.data(), buf.size());
+    }
+    fprintf(stderr, "fuzz: %s: %ld iterations ok (seed %llu, corpus %zu)\n",
+            argv[0], iters, (unsigned long long)seed, corpus.size());
+    return 0;
+}
+
+}  // namespace fuzz
+
+#endif /* BINDER_FUZZ_UTIL_H */

@@ -1,0 +1,46 @@
+/*
+ * Fuzz target: zlogcat's txnlog record walk + body decoders
+ * (zklog/zlogcat.cpp do_buffer), which parse forensic files that may be
+ * torn, truncated, or corrupted (the reference tool mmaps and walks
+ * with hand-checked offsets, src/zklog.c:262-268 — same paranoia
+ * expected here, enforced by ASan/UBSan).
+ *
+ * stdout is redirected to /dev/null: the decoder prints a JSON line per
+ * record and the fuzzer would otherwise spend its time in write(2).
+ */
+#define main zlogcat_main_unused
+#include "../zklog/zlogcat.cpp"
+#undef main
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include "fuzz_util.h"
+
+void fuzz_setup() {
+    /* stderr too: the decoder prints a diagnostic per bad record, which
+     * is every mutated input; success is the exit code */
+    int devnull = open("/dev/null", O_WRONLY);
+    if (devnull >= 0) {
+        dup2(devnull, 1);
+        dup2(devnull, 2);
+        close(devnull);
+    }
+}
+
+void fuzz_one(const uint8_t *data, size_t len) {
+    Filters f;
+    Stats st;
+    (void)do_buffer("<fuzz>", data, len, f, &st);
+
+    /* filter paths too (time window / session / server id) */
+    Filters f2;
+    f2.time_from = 0;
+    f2.time_to = 1;
+    f2.has_session = true;
+    f2.session = 0x100000042;
+    Stats st2;
+    (void)do_buffer("<fuzz>", data, len, f2, &st2);
+}
+
+int main(int argc, char **argv) { return fuzz::run(argc, argv); }

@@ -356,28 +356,12 @@ uint32_t adler32(const uint8_t *data, size_t len) {
     return (b << 16) | a;
 }
 
-bool do_file(const char *fname, const Filters &f, Stats *st) {
-    int fd = open(fname, O_RDONLY);
-    if (fd < 0) {
-        fprintf(stderr, "zlogcat: cannot open %s: %s\n", fname,
-                strerror(errno));
-        return false;
-    }
-    struct stat sb;
-    if (fstat(fd, &sb) != 0 || sb.st_size < 16) {
-        fprintf(stderr, "zlogcat: %s: too short for a txnlog\n", fname);
-        close(fd);
-        return false;
-    }
-    void *map = mmap(nullptr, (size_t)sb.st_size, PROT_READ, MAP_PRIVATE,
-                     fd, 0);
-    close(fd);
-    if (map == MAP_FAILED) {
-        fprintf(stderr, "zlogcat: mmap %s: %s\n", fname, strerror(errno));
-        return false;
-    }
-
-    Reader r{(const uint8_t *)map, (size_t)sb.st_size};
+/* Decode one mapped txnlog buffer.  Split from do_file so the record
+ * walk can be driven directly with hostile bytes (fuzz target
+ * native/fuzz/fuzz_zlog.cpp). */
+bool do_buffer(const char *fname, const uint8_t *data, size_t size,
+               const Filters &f, Stats *st) {
+    Reader r{data, size};
     uint32_t magic = r.u32();
     int32_t version = r.i32();
     int64_t dbid = r.i64();
@@ -385,7 +369,6 @@ bool do_file(const char *fname, const Filters &f, Stats *st) {
         fprintf(stderr,
                 "zlogcat: %s: bad file header (magic 0x%08X version %d)\n",
                 fname, magic, version);
-        munmap(map, (size_t)sb.st_size);
         return false;
     }
     printf("{\"file\": \"%s\", \"dbid\": %" PRId64 "}\n", fname, dbid);
@@ -472,8 +455,33 @@ bool do_file(const char *fname, const Filters &f, Stats *st) {
         st->txns++;
     }
 
-    munmap(map, (size_t)sb.st_size);
     return true;
+}
+
+bool do_file(const char *fname, const Filters &f, Stats *st) {
+    int fd = open(fname, O_RDONLY);
+    if (fd < 0) {
+        fprintf(stderr, "zlogcat: cannot open %s: %s\n", fname,
+                strerror(errno));
+        return false;
+    }
+    struct stat sb;
+    if (fstat(fd, &sb) != 0 || sb.st_size < 16) {
+        fprintf(stderr, "zlogcat: %s: too short for a txnlog\n", fname);
+        close(fd);
+        return false;
+    }
+    void *map = mmap(nullptr, (size_t)sb.st_size, PROT_READ, MAP_PRIVATE,
+                     fd, 0);
+    close(fd);
+    if (map == MAP_FAILED) {
+        fprintf(stderr, "zlogcat: mmap %s: %s\n", fname, strerror(errno));
+        return false;
+    }
+    bool ok = do_buffer(fname, (const uint8_t *)map, (size_t)sb.st_size,
+                        f, st);
+    munmap(map, (size_t)sb.st_size);
+    return ok;
 }
 
 }  // namespace
