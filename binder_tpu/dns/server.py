@@ -107,6 +107,12 @@ class DnsServer:
         # TCP clients only (balancer links are trusted local peers and
         # excluded from the cap/idle policy)
         self._tcp_conns: set = set()
+        # cap-refusal accounting: a connect flood at the cap must not
+        # become a log flood, so refusals log at most once per interval
+        # (with the count of everything refused since the last line)
+        self.tcp_cap_refusals = 0
+        self._cap_log_last = 0.0
+        self._cap_log_pending = 0
         self.on_query: Optional[Callable] = None   # async (QueryCtx) -> None
         self.on_after: Optional[Callable] = None   # sync  (QueryCtx) -> None
         self._udp_socks: List[tuple] = []   # (loop, socket)
@@ -435,8 +441,17 @@ class DnsServer:
             # at the connection cap: refuse the newcomer outright (the
             # idle timeout below guarantees slots recycle, so a
             # slowloris herd can't pin the front end shut for long)
-            self.log.warning("TCP connection cap (%d) reached, refusing "
-                             "%s", self.max_tcp_conns, peer[0])
+            self.tcp_cap_refusals += 1
+            self._cap_log_pending += 1
+            now = asyncio.get_running_loop().time()
+            if now - self._cap_log_last >= 5.0:
+                self.log.warning(
+                    "TCP connection cap (%d) reached, refused %d "
+                    "connection(s) since last report (latest: %s; full "
+                    "count in binder_tcp_cap_refusals)",
+                    self.max_tcp_conns, self._cap_log_pending, peer[0])
+                self._cap_log_last = now
+                self._cap_log_pending = 0
             writer.close()
             try:
                 await writer.wait_closed()

@@ -116,6 +116,13 @@ class BinderServer:
                                 max_tcp_write_buffer=max_tcp_write_buffer)
         self.engine.on_query = self._on_query
         self.engine.on_after = self._on_after
+        # the engine's cap-refusal log line is rate-limited, so the
+        # counter is the only complete record — surface it in the scrape
+        self._cap_refusal_child = self.collector.counter(
+            "binder_tcp_cap_refusals",
+            "TCP connections refused at the connection cap").labelled()
+        self._cap_folded = 0
+        self.collector.on_expose(self._fold_engine_counters)
 
         # Native fast path: answer-cache hits served inside the C UDP
         # drain (native/fastio/fastpath.c).  Python remains the source of
@@ -257,6 +264,15 @@ class BinderServer:
         return (bytes([flags]) + req.max_udp_payload().to_bytes(2, "big")
                 + q0.qtype.to_bytes(2, "big")
                 + q0.qclass.to_bytes(2, "big") + qname)
+
+    def _fold_engine_counters(self) -> None:
+        # scrapes run on ThreadingHTTPServer threads: fold under the
+        # shared lock or two concurrent scrapes double-count the delta
+        with self._fp_fold_lock:
+            delta = self.engine.tcp_cap_refusals - self._cap_folded
+            if delta > 0:
+                self._cap_refusal_child.inc(delta)
+                self._cap_folded += delta
 
     def _fold_fastpath_metrics(self) -> None:
         """Fold the C fast path's monotonic counters into the Prometheus

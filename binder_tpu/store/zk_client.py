@@ -181,10 +181,15 @@ class ZKClient(StoreClient):
                 return
             await asyncio.sleep(RECONNECT_DELAY)
 
-    async def _run_session(self) -> None:
-        host, port = self._servers[self._server_idx]
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), CONNECT_TIMEOUT)
+    async def _handshake(self, host: str, port: int):
+        """Connect and exchange the ConnectRequest/Response.
+
+        Runs under one CONNECT_TIMEOUT deadline (see _run_session): a
+        half-alive ensemble member that accepts TCP but never answers the
+        handshake must fail fast so server rotation can advance, instead
+        of stalling the session loop on the response read forever.
+        """
+        reader, writer = await asyncio.open_connection(host, port)
         self._writer = writer
         try:
             # ConnectRequest: protoVer, lastZxidSeen, timeout, sessionId,
@@ -195,8 +200,19 @@ class ZKClient(StoreClient):
                    + jute.buffer(self._passwd) + jute.boolean(False))
             writer.write(jute.frame(req))
             await writer.drain()
+            resp = await self._read_frame(reader)
+        except BaseException:
+            self._writer = None
+            writer.close()
+            raise
+        return reader, writer, resp
 
-            resp = Buf(await self._read_frame(reader))
+    async def _run_session(self) -> None:
+        host, port = self._servers[self._server_idx]
+        reader, writer, raw_resp = await asyncio.wait_for(
+            self._handshake(host, port), CONNECT_TIMEOUT)
+        try:
+            resp = Buf(raw_resp)
             resp.i32()  # protocol version
             timeout = resp.i32()
             session_id = resp.i64()

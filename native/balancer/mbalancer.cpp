@@ -1066,6 +1066,10 @@ void route_response(uint8_t family, uint8_t transport,
             tcp_client_close(it->second);
             return;
         }
+        /* delivering a response is activity: a client whose query takes
+         * longer than tcp_idle_ms, or that receives a steady stream of
+         * answers without writing again, must not be swept as idle */
+        tc.last_active = mono_s();
         if (tc.conn.want_write())
             epoll_mod(tc.conn.fd, EPOLLIN | EPOLLOUT,
                       tag(KIND_TCP_CLIENT, tc.conn.fd));
@@ -1178,7 +1182,10 @@ void handle_stats() {
         int fd = accept4(g_bal.stats_fd, nullptr, nullptr, SOCK_NONBLOCK);
         if (fd < 0) return;
         std::string out = "{\n";
-        char line[512];
+        /* 12 u64 fields at up to 20 digits each on top of ~250 bytes of
+         * literal text: 512 would truncate near-max counters and emit
+         * unparseable stats JSON */
+        char line[1024];
         snprintf(line, sizeof(line),
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"

@@ -244,6 +244,41 @@ class TestEnsembleFailover:
         with pytest.raises(ValueError):
             parse_connect_string("", 2181)
 
+    def test_half_alive_member_cannot_stall_rotation(self, monkeypatch):
+        """A member that accepts TCP but never answers the ConnectRequest
+        must fail within CONNECT_TIMEOUT so rotation advances (r2 advisor
+        medium: the handshake read used to have no deadline)."""
+        import binder_tpu.store.zk_client as zkmod
+        monkeypatch.setattr(zkmod, "CONNECT_TIMEOUT", 0.5)
+        monkeypatch.setattr(zkmod, "RECONNECT_DELAY", 0.05)
+
+        async def run():
+            # half-alive member: accepts connections, reads, never writes
+            async def black_hole(reader, writer):
+                try:
+                    await reader.read()
+                finally:
+                    writer.close()
+
+            tarpit = await asyncio.start_server(
+                black_hole, "127.0.0.1", 0)
+            tarpit_port = tarpit.sockets[0].getsockname()[1]
+            live = ZKTestServer()
+            await live.start()
+
+            client = ZKClient(
+                address=f"127.0.0.1:{tarpit_port},127.0.0.1:{live.port}",
+                port=2181, session_timeout_ms=2000)
+            client.start()
+            # must reach the live member despite the tarpit being first:
+            # well under the old failure mode (infinite stall)
+            assert await wait_for(client.is_connected, timeout=5.0)
+            client.close()
+            tarpit.close()
+            await live.stop()
+
+        asyncio.run(run())
+
     def test_mirror_rebuilds_via_surviving_server(self):
         async def run():
             s1 = ZKTestServer()
