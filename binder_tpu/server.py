@@ -9,7 +9,10 @@ socket (``lib/server.js:609-653``).
 from __future__ import annotations
 
 import logging
+import socket as _socket
+import struct
 import threading
+import time
 from typing import Optional
 
 try:  # native fast path (built by `make -C native`); optional
@@ -31,7 +34,7 @@ from binder_tpu.metrics.collector import (
     MetricsCollector,
 )
 from binder_tpu.resolver.answer_cache import AnswerCache
-from binder_tpu.resolver.engine import Resolver
+from binder_tpu.resolver.engine import DEFAULT_TTL, Resolver
 from binder_tpu.utils.jsonlog import log_event
 from binder_tpu.utils.probes import ProbeProvider
 
@@ -54,6 +57,38 @@ def strip_suffix(suffix: str, s: str) -> str:
     if s.endswith(suffix):
         return s[:len(s) - len(suffix)] + "..."
     return s
+
+
+# Pre-encoded EDNS echo for the raw lane: name 0, TYPE OPT(41),
+# CLASS=payload 1232, TTL 0, RDLEN 0 — byte-identical to the generic
+# path's _ECHO_OPT (dns/query.py) encoding.
+_OPT_ECHO_WIRE = b"\x00" + struct.pack(">HHIH", 41, 1232, 0, 0)
+
+# Record types the raw lane may answer directly: exactly the host-likes
+# the resolver maps to a single A record (resolver/engine.py:213-216).
+# 'service' (rotation, SRV) and 'database' (URL parse) take the generic
+# path.
+_LANE_HOST_TYPES = frozenset({
+    "db_host", "host", "load_balancer", "moray_host", "redis_host",
+    "ops_host", "rr_host",
+})
+
+
+def _fastpath_key_parts(rd: bool, edns: bool, payload: int, qtype: int,
+                        qclass: int, qname_wire: bytes) -> bytes:
+    """The native answer-cache key, from its components.
+
+    SINGLE SOURCE OF THE LAYOUT on the Python side — both
+    ``BinderServer._fastpath_key`` and the raw lane build through here.
+    Must stay byte-for-byte with ``fp_build_key`` in
+    native/fastio/fastpath.c and the balancer's copy (see
+    docs/balancer-protocol.md):
+    ``[flags rd|edns<<1][payload BE16][qtype BE16][qclass BE16][qname]``
+    where qname is the wire-format name, lowercased.
+    """
+    return (bytes([(1 if rd else 0) | (2 if edns else 0)])
+            + payload.to_bytes(2, "big") + qtype.to_bytes(2, "big")
+            + qclass.to_bytes(2, "big") + qname_wire)
 
 
 class BinderServer:
@@ -123,6 +158,14 @@ class BinderServer:
             "TCP connections refused at the connection cap").labelled()
         self._cap_folded = 0
         self.collector.on_expose(self._fold_engine_counters)
+
+        # Raw resolve lane: direct wire assembly for single-question A/IN
+        # queries (see _raw_lane).  Policy strings mirror Resolver.resolve
+        # exactly; the lane declines anything it can't prove simple.
+        dd = self.resolver.dns_domain
+        self._lane_suffix = ("." + dd) if dd else None
+        self._lane_dcsuff = dd + "." + self.resolver.datacenter_name
+        self.engine.raw_lane = self._raw_lane
 
         # Native fast path: answer-cache hits served inside the C UDP
         # drain (native/fastio/fastpath.c).  Python remains the source of
@@ -258,12 +301,240 @@ class BinderServer:
                     return None
         except IndexError:
             return None
-        qname = raw[12:off].lower()
         q0 = req.questions[0]
-        flags = (1 if req.rd else 0) | (2 if req.edns is not None else 0)
-        return (bytes([flags]) + req.max_udp_payload().to_bytes(2, "big")
-                + q0.qtype.to_bytes(2, "big")
-                + q0.qclass.to_bytes(2, "big") + qname)
+        return _fastpath_key_parts(req.rd, req.edns is not None,
+                                   req.max_udp_payload(), q0.qtype,
+                                   q0.qclass, raw[12:off].lower())
+
+    def _raw_lane(self, data: bytes, src, protocol: str, send,
+                  client_transport: Optional[str] = None) -> bool:
+        """Direct-assembly resolve for the dominant query shape: one
+        A/IN question, optionally with a bare EDNS OPT.
+
+        The generic path costs ~60µs per cold name (Message decode,
+        QueryCtx, resolver, Message encode); this lane answers the same
+        shapes in a few µs by patching the request wire: header rewrite,
+        verbatim question echo, one compression-pointer A record.  It
+        mirrors ``Resolver.resolve``'s policy exactly for the shapes it
+        accepts — suffix / doubled-suffix REFUSED, store-down SERVFAIL,
+        TTL precedence, REFUSED-not-NXDOMAIN on misses
+        (lib/server.js:227-241) — and is differential-tested against the
+        generic path (tests/test_raw_lane.py).  Everything else —
+        other qtypes, EDNS options, service/database records, the
+        recursion handoff, invalid records, query-log/probes active —
+        returns False and takes the generic path, so divergence is
+        impossible for declined shapes.
+
+        One deliberate improvement over the generic path: the question
+        section is echoed with the requester's original case (dns0x20
+        compatible), where the generic encoder re-emits it lowercased.
+        """
+        if (self.query_log or self.p_req_start.enabled
+                or self.p_req_done.enabled):
+            return False
+        dd_suffix = self._lane_suffix
+        if dd_suffix is None:
+            return False
+        n = len(data)
+        if n < 17:
+            return False
+        # header: QR / opcode / TC must be clear; QD=1; AN=NS=0; AR<=1
+        if data[2] & 0xFA:
+            return False
+        if (data[4] or data[5] != 1 or data[6] or data[7] or data[8]
+                or data[9] or data[10] or data[11] > 1):
+            return False
+        start = time.monotonic()
+        # question name: case-preserving walk, charset-validated (the
+        # charset equals the resolver's NAME_RE alphabet, so names the
+        # lane declines here are exactly the generic path's
+        # invalid-name REFUSED shapes plus non-ASCII oddities)
+        labels = []
+        off = 12
+        ok = _FP_NAME_OK.issuperset
+        while True:
+            ll = data[off]
+            if ll == 0:
+                off += 1
+                break
+            if ll & 0xC0:
+                return False           # compressed qname
+            end = off + 1 + ll
+            if end + 1 > n:
+                return False
+            if not ok(data[off + 1:end]):
+                return False
+            labels.append(data[off + 1:end])
+            off = end
+            if off - 12 > 255:
+                return False
+        if off + 4 > n:
+            return False
+        if data[off:off + 4] != b"\x00\x01\x00\x01":   # A / IN only
+            return False
+        q_end = off + 4
+        edns = False
+        payload = 512
+        if data[11]:
+            # exactly one bare OPT: root name, TYPE 41, version 0, no
+            # RDATA (EDNS options vary per packet and take the generic
+            # path; so do nonzero versions)
+            if q_end + 11 != n or data[q_end] != 0:
+                return False
+            otype, ocls = struct.unpack_from(">HH", data, q_end + 1)
+            if otype != 41 or data[q_end + 6] != 0:
+                return False
+            if data[q_end + 9] or data[q_end + 10]:
+                return False
+            if ocls >= 512:
+                payload = min(ocls, 4096)
+            edns = True
+        elif q_end != n:
+            return False               # trailing bytes
+        try:
+            name = b".".join(labels).lower().decode("ascii")
+        except UnicodeDecodeError:
+            return False
+
+        rd_flag = data[2] & 0x01
+        udp_sem = (protocol == "udp"
+                   or (protocol == "balancer" and client_transport != "tcp"))
+        # the key layout must stay byte-for-byte with _on_query's
+        key = (udp_sem, bool(rd_flag), 1, 1, name, edns, payload)
+        cache = self.zk_cache
+        gen = cache.gen
+        hit = self.answer_cache.get(key, gen)
+        if hit is not None:
+            cached = hit[0]
+            # patch in this requester's id AND question bytes: cached
+            # wires store the question lowercased (see the put below), so
+            # echoing the requester's own bytes keeps dns0x20 validators
+            # happy; same name/qtype keyed -> identical section length
+            wire = (data[:2] + cached[2:12] + data[12:q_end]
+                    + cached[q_end:])
+            send(wire)
+            try:
+                self._cache_hit_child.inc()
+                self._lane_finish(data, src, protocol, start, wire,
+                                  wire[3] & 0x0F, edns, hit[1], hit[2],
+                                  cached=True)
+            except Exception:
+                # response already sent: never fall through to the
+                # generic path (it would answer a second time)
+                self.log.exception("raw lane post-send bookkeeping failed")
+            return True
+
+        # -- resolution (mirrors Resolver.resolve ordering exactly) --
+        rcode = 0
+        node = None
+        if not name.endswith(dd_suffix):
+            rcode = Rcode.REFUSED      # not within dns domain suffix
+        else:
+            stripped = name[:-len(dd_suffix)]
+            dd = self.resolver.dns_domain
+            if (stripped == dd or stripped.endswith(dd_suffix)
+                    or stripped == self._lane_dcsuff
+                    or stripped.endswith("." + self._lane_dcsuff)):
+                rcode = Rcode.REFUSED  # doubled-up dns domain suffix
+            elif not cache.is_ready():
+                self.log.error("no coordination-store session")
+                rcode = Rcode.SERVFAIL
+            else:
+                node = cache.lookup(name)
+                if node is None:
+                    if self.resolver.recursion is not None and rd_flag:
+                        return False   # recursion handoff: generic path
+                    rcode = Rcode.REFUSED
+
+        body = b""
+        ancount = 0
+        addr = None
+        if rcode == 0 and node is not None:
+            record = node.data
+            rt = record.get("type") if type(record) is dict else None
+            if rt not in _LANE_HOST_TYPES:
+                return False           # service/database/invalid record
+            sub = record.get(rt)
+            if type(sub) is not dict:
+                return False
+            addr = sub.get("address")
+            if type(addr) is not str:
+                return False
+            try:
+                packed = _socket.inet_aton(addr)
+            except (OSError, TypeError):
+                return False           # generic path SERVFAILs
+            if _socket.inet_ntoa(packed) != addr:
+                return False           # non-canonical dotted quad
+            ttl = record.get("ttl")
+            sttl = sub.get("ttl")
+            if sttl is not None:
+                ttl = sttl
+            elif ttl is None:
+                ttl = DEFAULT_TTL
+            if type(ttl) is not int:
+                return False           # store garbage: generic path
+            body = (b"\xc0\x0c\x00\x01\x00\x01"
+                    + struct.pack(">IH", ttl & 0xFFFFFFFF, 4) + packed)
+            ancount = 1
+
+        flags_out = 0x8400 | (0x0100 if rd_flag else 0) | rcode
+        wire = (data[:2]
+                + struct.pack(">HHHHH", flags_out, 1, ancount, 0,
+                              1 if edns else 0)
+                + data[12:q_end] + body
+                + (_OPT_ECHO_WIRE if edns else b""))
+        send(wire)
+        try:
+            ans = ([f"{strip_suffix(dd_suffix, name)} A {addr}"]
+                   if ancount else [])
+            self._lane_finish(data, src, protocol, start, wire, rcode,
+                              edns, ans, [])
+            if rcode != Rcode.SERVFAIL:
+                # cache entries carry a lowercased question so hits can
+                # splice in each requester's own case (and so generic
+                # respond_raw hits keep today's lowercase echo)
+                q_sec = data[12:q_end]
+                q_low = q_sec.lower()
+                cache_wire = (wire if q_sec == q_low
+                              else wire[:12] + q_low + wire[q_end:])
+                completed = self.answer_cache.put(
+                    key, gen, (cache_wire, ans, []), rotatable=False)
+                if (completed and self._fastpath is not None and udp_sem
+                        and self._fastpath_active()):
+                    ckey = _fastpath_key_parts(
+                        bool(rd_flag), edns, payload, 1, 1,
+                        data[12:q_end - 4].lower())
+                    try:
+                        _fastio.fastpath_put(
+                            self._fastpath, ckey, 1, gen, [cache_wire],
+                            int(self.answer_cache.expiry_s * 1000))
+                    except (TypeError, ValueError, MemoryError) as e:
+                        self.log.debug("fastpath push skipped: %s", e)
+        except Exception:
+            # response already sent: never fall through to the generic
+            # path (it would answer a second time)
+            self.log.exception("raw lane post-send bookkeeping failed")
+        return True
+
+    def _lane_finish(self, data, src, protocol: str, start: float,
+                     wire: bytes, rcode: int, edns: bool, ans, add,
+                     cached: bool = False) -> None:
+        """Metrics + the slow-query warn for a lane-handled query
+        (the lane equivalent of _on_after with queryLog off)."""
+        lat_s = time.monotonic() - start
+        ch = self._children_for(1)
+        ch[0].inc()
+        ch[1].observe(lat_s)
+        ch[2].observe(len(wire))
+        lat_ms = lat_s * 1000.0
+        if lat_ms > SLOW_QUERY_MS:
+            log_event(self.log, logging.WARNING, "DNS query",
+                      req_id=(data[0] << 8) | data[1], client=src[0],
+                      port=f"{src[1]}/{protocol}", edns=edns,
+                      cached=cached, rcode=Rcode.name(rcode),
+                      answers=ans, additional=add, latency=lat_ms,
+                      timers={})
 
     def _fold_engine_counters(self) -> None:
         # scrapes run on ThreadingHTTPServer threads: fold under the
