@@ -91,7 +91,8 @@ class Opcode:
 
 MAX_NAME_LEN = 255
 MAX_LABEL_LEN = 63
-MAX_UDP_PAYLOAD = 512  # classic; EDNS extends
+MAX_UDP_PAYLOAD = 512   # classic; EDNS extends
+MAX_EDNS_PAYLOAD = 4096  # ceiling we honor from an OPT advertisement
 
 
 class WireError(Exception):
@@ -598,8 +599,8 @@ class Message:
 
     def max_udp_payload(self) -> int:
         opt = self.edns
-        if opt is not None and opt.udp_payload_size >= 512:
-            return min(opt.udp_payload_size, 4096)
+        if opt is not None and opt.udp_payload_size >= MAX_UDP_PAYLOAD:
+            return min(opt.udp_payload_size, MAX_EDNS_PAYLOAD)
         return MAX_UDP_PAYLOAD
 
 

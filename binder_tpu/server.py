@@ -23,6 +23,8 @@ except ImportError:
 from binder_tpu.dns.query import QueryCtx
 from binder_tpu.dns.server import DnsServer
 from binder_tpu.dns.wire import (
+    MAX_EDNS_PAYLOAD,
+    MAX_UDP_PAYLOAD,
     ARecord,
     OPTRecord,
     Rcode,
@@ -374,7 +376,7 @@ class BinderServer:
             return False
         q_end = off + 4
         edns = False
-        payload = 512
+        payload = MAX_UDP_PAYLOAD
         if data[11]:
             # exactly one bare OPT: root name, TYPE 41, version 0, no
             # RDATA (EDNS options vary per packet and take the generic
@@ -386,8 +388,10 @@ class BinderServer:
                 return False
             if data[q_end + 9] or data[q_end + 10]:
                 return False
-            if ocls >= 512:
-                payload = min(ocls, 4096)
+            # same floor/clamp as Message.max_udp_payload — shared
+            # constants so the copies cannot drift
+            if ocls >= MAX_UDP_PAYLOAD:
+                payload = min(ocls, MAX_EDNS_PAYLOAD)
             edns = True
         elif q_end != n:
             return False               # trailing bytes

@@ -135,18 +135,22 @@ class TestDifferential:
             assert resp.rcode == Rcode.SERVFAIL
 
     def test_cache_key_parity_lane_fills_generic_hits(self):
-        """A lane-resolved entry must be a generic-path cache hit."""
-        _, cache = make_fixture()
-        srv = new_server(cache, lane=True)
-        wire = make_query("web.foo.com", Type.A, qid=9,
-                          edns_payload=1232).encode()
-        first = ask_raw(srv, wire)
-        # disable the lane; the generic path must hit the same entry
-        srv.engine.raw_lane = None
-        hits_before = srv.answer_cache.hits
-        second = ask_raw(srv, wire)
-        assert srv.answer_cache.hits == hits_before + 1
-        assert first == second
+        """A lane-resolved entry must be a generic-path cache hit — for
+        every EDNS payload edge (none, below floor, typical, above
+        clamp), so a drifting floor/clamp copy splits the cache and
+        fails here."""
+        for payload in (None, 100, 511, 512, 1232, 4096, 4097):
+            _, cache = make_fixture()
+            srv = new_server(cache, lane=True)
+            wire = make_query("web.foo.com", Type.A, qid=9,
+                              edns_payload=payload).encode()
+            first = ask_raw(srv, wire)
+            # disable the lane; the generic path must hit the same entry
+            srv.engine.raw_lane = None
+            hits_before = srv.answer_cache.hits
+            second = ask_raw(srv, wire)
+            assert srv.answer_cache.hits == hits_before + 1, payload
+            assert first == second, payload
 
     def test_cache_key_parity_generic_fills_lane_hits(self):
         _, cache = make_fixture()
