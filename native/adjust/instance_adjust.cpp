@@ -9,16 +9,26 @@
  * not restarted.
  *
  * The reference reconciles against illumos SMF (libscf).  This rebuild
- * reconciles against a portable process-supervision state directory — the
- * service-manager role the reference delegates to SMF:
+ * supports two service managers behind one plan/diff/no-op core:
  *
- *   <statedir>/<name>.props   property group {instance, socket_path, exec}
- *                             (the config PG smf_adjust writes,
- *                             src/smf_adjust.c:44,1060-1090)
- *   <statedir>/<name>.pid     supervised process id
- *   <statedir>/<name>.log     instance stdout/stderr
+ *  -m systemd   (production; auto-selected when systemd is booted)
+ *    Drives the shipped template units deploy/systemd/binder@.service via
+ *    systemctl.  The per-instance config property group smf_adjust writes
+ *    (src/smf_adjust.c:44,1060-1090) becomes a drop-in
+ *    <dropin-root>/<base>@<port>.service.d/50-instance.conf setting
+ *    BINDER_PORT / BINDER_SOCKET_PATH; drop-in equality is the
+ *    nvlist_equal no-op check, `systemctl reset-failed` + start is the
+ *    maintenance/degraded restore (flush_status, smfx.c:242-336), and
+ *    disable --now -> poll is-active -> delete drop-in mirrors the
+ *    disable/wait/delete removal loop (smf_adjust.c:189-257).
  *
- * Reconciliation semantics preserved from the reference:
+ *  -m statedir  (supervisor-less fallback: containers, dev, tests)
+ *    A built-in pid-file supervisor over a state directory:
+ *      <statedir>/<name>.props   property group {instance, socket_path, exec}
+ *      <statedir>/<name>.pid     supervised process id
+ *      <statedir>/<name>.log     instance stdout/stderr
+ *
+ * Reconciliation semantics preserved from the reference in both backends:
  *  - planned set built first, existing instances walked and unwanted ones
  *    marked (smf_adjust.c:964-1015);
  *  - surplus removed via stop -> poll-until-gone -> delete
@@ -26,19 +36,22 @@
  *  - per-instance config compared order-insensitively against the current
  *    property group; identical config skips the restart entirely
  *    (nvlist_equal no-op detection, smf_adjust.c:337-455);
- *  - dead-but-registered instances are restarted (flush_status analog,
- *    smfx.c:242-336);
- *  - -w waits up to 60s for instances to come online (process alive +
- *    balancer socket present) (smf_adjust.c:457-544);
+ *  - failed/dead-but-registered instances are restored (flush_status
+ *    analog, smfx.c:242-336);
+ *  - -w waits up to 60s for instances to come online (unit active /
+ *    process alive + balancer socket present) (smf_adjust.c:457-544);
  *  - -r <cmd> runs once after changes, re-publishing metric ports (the
  *    metric-ports-updater restart, smf_adjust.c:1119-1136).
  *
  * Usage:
- *   instance_adjust -s <statedir> -b <base> -B <baseport> -i <count>
- *                   -e <exec-template> [-d <sockdir>] [-r <cmd>] [-w] [-n]
+ *   instance_adjust [-m auto|systemd|statedir]
+ *                   -s <statedir> | -D <dropin-root>
+ *                   -b <base> -B <baseport> -i <count>
+ *                   [-e <exec-template>] [-d <sockdir>] [-r <cmd>] [-w] [-n]
  *
- * The exec template may contain %P (port), %S (socket path), %N (name).
- * -n = dry run (print actions only).
+ * The exec template (statedir backend) may contain %P (port), %S (socket
+ * path), %N (name).  -n = dry run (print actions only).  systemctl is
+ * resolved via PATH so tests can substitute a fake.
  */
 #include <dirent.h>
 #include <errno.h>
@@ -55,6 +68,8 @@
 #include <unistd.h>
 
 #include <map>
+#include <memory>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -64,7 +79,9 @@ constexpr int kStopWaitMs = 10000;    /* disable poll (smf_adjust.c:189) */
 constexpr int kOnlineWaitMs = 60000;  /* -w bound (smf_adjust.c:457) */
 
 struct Options {
+    std::string manager = "auto";
     std::string statedir;
+    std::string dropin_root = "/etc/systemd/system";
     std::string base = "binder";
     int baseport = 5301;
     int count = -1;
@@ -84,6 +101,40 @@ void msleep(int ms) {
 
 std::string path_join(const std::string &a, const std::string &b) {
     return a + "/" + b;
+}
+
+/* run argv, capture stdout; returns exit status or -1 */
+int run_capture(const std::vector<std::string> &argv, std::string *out) {
+    int fds[2];
+    if (pipe(fds) != 0) return -1;
+    pid_t pid = fork();
+    if (pid < 0) {
+        close(fds[0]);
+        close(fds[1]);
+        return -1;
+    }
+    if (pid == 0) {
+        close(fds[0]);
+        dup2(fds[1], 1);
+        if (fds[1] > 2) close(fds[1]);
+        std::vector<char *> cargv;
+        for (const auto &a : argv)
+            cargv.push_back(const_cast<char *>(a.c_str()));
+        cargv.push_back(nullptr);
+        execvp(cargv[0], cargv.data());
+        _exit(127);
+    }
+    close(fds[1]);
+    if (out != nullptr) {
+        char buf[4096];
+        ssize_t n;
+        while ((n = read(fds[0], buf, sizeof(buf))) > 0)
+            out->append(buf, (size_t)n);
+    }
+    close(fds[0]);
+    int status = 0;
+    while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {}
+    return WIFEXITED(status) ? WEXITSTATUS(status) : -1;
 }
 
 /* ---- property-group file I/O (the SMF config PG analog) ---- */
@@ -121,7 +172,7 @@ bool props_equal(const Props &a, const Props &b) {
     return a == b;
 }
 
-/* ---- process supervision ---- */
+/* ---- process supervision (statedir backend) ---- */
 
 pid_t read_pid(const std::string &pidfile) {
     FILE *f = fopen(pidfile.c_str(), "r");
@@ -169,16 +220,49 @@ std::string substitute(const std::string &tmpl, int port,
 /* ---- one instance ---- */
 
 struct Instance {
-    std::string name;
+    std::string name;       /* <base>-<port> (display / statedir key) */
     int port = 0;
     bool planned = false;   /* in the desired set */
-    bool exists = false;    /* props file present */
+    bool exists = false;    /* known to the service manager */
 };
 
-struct Reconciler {
+/* A numeric tail after "<base>-" / "<base>@"; anything else belongs to
+ * another instance set sharing a prefix (binder vs binder-blue) and must
+ * not be claimed and torn down. */
+bool parse_port_tail(const std::string &tail, int *port) {
+    if (tail.empty() ||
+        tail.find_first_not_of("0123456789") != std::string::npos)
+        return false;
+    *port = atoi(tail.c_str());
+    return true;
+}
+
+/* Service-manager backend: everything below the shared plan/diff core.
+ * The reference's equivalent split is smf_adjust (plan) over smfx
+ * (manager eccentricities). */
+struct ServiceManager {
+    virtual ~ServiceManager() = default;
+    /* existing instance names+ports (the libscf instance walk,
+     * smf_adjust.c:975-1015) */
+    virtual std::vector<Instance> discover() = 0;
+    virtual bool remove_instance(const Instance &in) = 0;
+    /* write desired config; *noop=true if identical (nvlist_equal path) */
+    virtual bool configure_instance(const Instance &in, bool *needs_restart,
+                                    bool *noop) = 0;
+    virtual bool ensure_running(const Instance &in, bool needs_restart) = 0;
+    virtual bool wait_online(const Instance &in) = 0;
+    /* end-of-run hook (e.g. flush a pending config reload after a
+     * removal-only converge) */
+    virtual void finish() {}
+};
+
+/* ---- statedir backend: built-in pid-file supervisor ---- */
+
+struct StatedirManager : ServiceManager {
     Options opt;
-    std::vector<Instance> insts;
-    bool changed = false;
+    bool *changed;
+
+    StatedirManager(const Options &o, bool *ch) : opt(o), changed(ch) {}
 
     std::string props_file(const std::string &n) {
         return path_join(opt.statedir, n + ".props");
@@ -203,55 +287,36 @@ struct Reconciler {
         return p;
     }
 
-    /* plan + walk (smf_adjust.c:964-1015) */
-    void build_sets() {
-        std::map<std::string, Instance> by_name;
-        for (int i = 0; i < opt.count; i++) {
-            Instance in;
-            in.port = opt.baseport + i;
-            in.name = opt.base + "-" + std::to_string(in.port);
-            in.planned = true;
-            by_name[in.name] = in;
-        }
+    std::vector<Instance> discover() override {
+        std::vector<Instance> out;
         DIR *d = opendir(opt.statedir.c_str());
-        if (d != nullptr) {
-            struct dirent *de;
-            std::string suffix = ".props";
-            while ((de = readdir(d)) != nullptr) {
-                std::string fn = de->d_name;
-                if (fn.size() <= suffix.size() ||
-                    fn.compare(fn.size() - suffix.size(), suffix.size(),
-                               suffix) != 0)
-                    continue;
-                std::string name = fn.substr(0, fn.size() - suffix.size());
-                if (name.compare(0, opt.base.size() + 1, opt.base + "-") != 0)
-                    continue;   /* not ours */
-                /* the suffix must be a bare port number, or another
-                 * instance set sharing a prefix (binder vs binder-blue)
-                 * would be claimed and torn down */
-                std::string tail = name.substr(opt.base.size() + 1);
-                if (tail.empty() ||
-                    tail.find_first_not_of("0123456789") != std::string::npos)
-                    continue;
-                auto it = by_name.find(name);
-                if (it == by_name.end()) {
-                    Instance in;       /* unwanted: marked for removal */
-                    in.name = name;
-                    in.exists = true;
-                    by_name[name] = in;
-                } else {
-                    it->second.exists = true;
-                }
-            }
-            closedir(d);
+        if (d == nullptr) return out;
+        struct dirent *de;
+        std::string suffix = ".props";
+        while ((de = readdir(d)) != nullptr) {
+            std::string fn = de->d_name;
+            if (fn.size() <= suffix.size() ||
+                fn.compare(fn.size() - suffix.size(), suffix.size(),
+                           suffix) != 0)
+                continue;
+            std::string name = fn.substr(0, fn.size() - suffix.size());
+            if (name.compare(0, opt.base.size() + 1, opt.base + "-") != 0)
+                continue;   /* not ours */
+            Instance in;
+            if (!parse_port_tail(name.substr(opt.base.size() + 1), &in.port))
+                continue;
+            in.name = name;
+            in.exists = true;
+            out.push_back(in);
         }
-        for (auto &kv : by_name) insts.push_back(kv.second);
+        closedir(d);
+        return out;
     }
 
     /* stop -> poll -> delete (remove_instance, smf_adjust.c:189-257) */
-    bool remove_instance(const Instance &in) {
+    bool remove_instance(const Instance &in) override {
         printf("remove %s\n", in.name.c_str());
-        changed = true;
+        *changed = true;
         if (opt.dry_run) return true;
         pid_t pid = read_pid(pid_file(in.name));
         if (process_alive(pid)) {
@@ -289,7 +354,7 @@ struct Reconciler {
 
     /* configure with no-op detection (smf_adjust.c:337-455) */
     bool configure_instance(const Instance &in, bool *needs_restart,
-                            bool *noop) {
+                            bool *noop) override {
         Props current, desired = desired_props(in);
         bool had = read_props(props_file(in.name), &current);
         if (had && props_equal(current, desired)) {
@@ -298,7 +363,7 @@ struct Reconciler {
             return true;
         }
         printf("%s %s\n", had ? "configure" : "create", in.name.c_str());
-        changed = true;
+        *changed = true;
         *noop = false;
         *needs_restart = had;   /* fresh instances just start */
         if (opt.dry_run) return true;
@@ -307,7 +372,7 @@ struct Reconciler {
 
     bool start_instance(const Instance &in) {
         printf("start %s\n", in.name.c_str());
-        changed = true;
+        *changed = true;
         if (opt.dry_run) return true;
         Props props;
         read_props(props_file(in.name), &props);
@@ -344,8 +409,9 @@ struct Reconciler {
         return true;
     }
 
-    /* enable + optional online wait (smf_adjust.c:457-544) */
-    bool ensure_running(const Instance &in) {
+    /* enable + restore (smf_adjust.c:457-544; flush_status analog) */
+    bool ensure_running(const Instance &in, bool needs_restart) override {
+        if (needs_restart && !opt.dry_run) stop_instance(in);
         pid_t pid = read_pid(pid_file(in.name));
         if (process_alive(pid)) return true;
         if (pid > 0) {
@@ -357,7 +423,7 @@ struct Reconciler {
         return start_instance(in);
     }
 
-    bool wait_online(const Instance &in) {
+    bool wait_online(const Instance &in) override {
         int waited = 0;
         std::string sock = socket_path(in.port);
         while (waited < kOnlineWaitMs) {
@@ -378,6 +444,313 @@ struct Reconciler {
                 in.name.c_str());
         return false;
     }
+};
+
+/* ---- systemd backend: drives deploy/systemd/binder@.service ---- */
+
+struct SystemdManager : ServiceManager {
+    Options opt;
+    bool *changed;
+    bool reload_pending = false;
+
+    SystemdManager(const Options &o, bool *ch) : opt(o), changed(ch) {}
+
+    std::string unit(int port) {
+        return opt.base + "@" + std::to_string(port) + ".service";
+    }
+    std::string dropin_dir(int port) {
+        return path_join(opt.dropin_root, unit(port) + ".d");
+    }
+    std::string dropin_file(int port) {
+        return path_join(dropin_dir(port), "50-instance.conf");
+    }
+    std::string socket_path(int port) {
+        std::string dir = opt.sockdir.empty() ? "/run/binder/sockets"
+                                              : opt.sockdir;
+        return path_join(dir, std::to_string(port));
+    }
+
+    int sysctl(const std::vector<std::string> &args, std::string *out) {
+        std::vector<std::string> argv = {"systemctl"};
+        argv.insert(argv.end(), args.begin(), args.end());
+        return run_capture(argv, out);
+    }
+
+    /* batch daemon-reload: run once before the first start/restart after
+     * any drop-in edit */
+    void maybe_reload() {
+        if (!reload_pending || opt.dry_run) return;
+        sysctl({"daemon-reload"}, nullptr);
+        reload_pending = false;
+    }
+
+    /* a removal-only converge deletes drop-ins without a later
+     * start/restart; systemd must still drop its cached copies */
+    void finish() override { maybe_reload(); }
+
+    std::string active_state(int port) {
+        std::string out;
+        if (sysctl({"show", "-p", "ActiveState", "--value", unit(port)},
+                   &out) != 0)
+            return "unknown";
+        while (!out.empty() && (out.back() == '\n' || out.back() == ' '))
+            out.pop_back();
+        return out.empty() ? "unknown" : out;
+    }
+
+    /* the config property group, as drop-in Environment= lines */
+    Props desired_props(const Instance &in) {
+        Props p;
+        p["BINDER_PORT"] = std::to_string(in.port);
+        p["BINDER_SOCKET_PATH"] = socket_path(in.port);
+        return p;
+    }
+
+    bool read_dropin(int port, Props *out) {
+        FILE *f = fopen(dropin_file(port).c_str(), "r");
+        if (f == nullptr) return false;
+        char line[1024];
+        while (fgets(line, sizeof(line), f) != nullptr) {
+            char *nl = strchr(line, '\n');
+            if (nl) *nl = '\0';
+            if (strncmp(line, "Environment=", 12) != 0) continue;
+            char *eq = strchr(line + 12, '=');
+            if (eq == nullptr) continue;
+            *eq = '\0';
+            (*out)[line + 12] = eq + 1;
+        }
+        fclose(f);
+        return true;
+    }
+
+    bool write_dropin(int port, const Props &props) {
+        mkdir(dropin_dir(port).c_str(), 0755);
+        std::string tmp = dropin_file(port) + ".tmp";
+        FILE *f = fopen(tmp.c_str(), "w");
+        if (f == nullptr) return false;
+        fprintf(f, "# written by instance_adjust; the per-instance config\n"
+                   "# property group (ref src/smf_adjust.c:1060-1090)\n"
+                   "[Service]\n");
+        for (const auto &kv : props)
+            fprintf(f, "Environment=%s=%s\n", kv.first.c_str(),
+                    kv.second.c_str());
+        fclose(f);
+        if (rename(tmp.c_str(), dropin_file(port).c_str()) != 0)
+            return false;
+        reload_pending = true;
+        return true;
+    }
+
+    /* union of: configured drop-ins, enabled unit files, loaded units —
+     * the libscf instance-iteration analog (smf_adjust.c:975-1015) */
+    std::vector<Instance> discover() override {
+        std::set<int> ports;
+
+        DIR *d = opendir(opt.dropin_root.c_str());
+        if (d != nullptr) {
+            struct dirent *de;
+            std::string prefix = opt.base + "@";
+            std::string suffix = ".service.d";
+            while ((de = readdir(d)) != nullptr) {
+                std::string fn = de->d_name;
+                if (fn.compare(0, prefix.size(), prefix) != 0) continue;
+                if (fn.size() <= prefix.size() + suffix.size() ||
+                    fn.compare(fn.size() - suffix.size(), suffix.size(),
+                               suffix) != 0)
+                    continue;
+                int port;
+                if (parse_port_tail(fn.substr(prefix.size(),
+                        fn.size() - prefix.size() - suffix.size()), &port))
+                    ports.insert(port);
+            }
+            closedir(d);
+        }
+
+        for (const char *mode : {"units", "unit-files"}) {
+            std::string out;
+            std::vector<std::string> args = {std::string("list-") + mode};
+            if (strcmp(mode, "units") == 0) {
+                args.push_back("--all");
+                args.push_back("--plain");   /* list-unit-files rejects it */
+            }
+            args.push_back("--no-legend");
+            args.push_back(opt.base + "@*.service");
+            if (sysctl(args, &out) != 0) continue;
+            size_t pos = 0;
+            while (pos < out.size()) {
+                size_t eol = out.find('\n', pos);
+                if (eol == std::string::npos) eol = out.size();
+                std::string line = out.substr(pos, eol - pos);
+                pos = eol + 1;
+                size_t sp = line.find_first_of(" \t");
+                std::string uname =
+                    sp == std::string::npos ? line : line.substr(0, sp);
+                std::string prefix = opt.base + "@";
+                std::string suffix = ".service";
+                if (uname.compare(0, prefix.size(), prefix) != 0) continue;
+                if (uname.size() <= prefix.size() + suffix.size()) continue;
+                if (uname.compare(uname.size() - suffix.size(),
+                                  suffix.size(), suffix) != 0)
+                    continue;
+                int port;
+                if (parse_port_tail(uname.substr(prefix.size(),
+                        uname.size() - prefix.size() - suffix.size()),
+                        &port))
+                    ports.insert(port);
+            }
+        }
+
+        std::vector<Instance> out;
+        for (int port : ports) {
+            Instance in;
+            in.port = port;
+            in.name = opt.base + "-" + std::to_string(port);
+            in.exists = true;
+            out.push_back(in);
+        }
+        return out;
+    }
+
+    /* disable --now -> poll is-active -> delete drop-in
+     * (remove_instance, smf_adjust.c:189-257) */
+    bool remove_instance(const Instance &in) override {
+        printf("remove %s\n", in.name.c_str());
+        *changed = true;
+        if (opt.dry_run) return true;
+        sysctl({"disable", "--now", unit(in.port)}, nullptr);
+        int waited = 0;
+        while (waited < kStopWaitMs) {
+            std::string st = active_state(in.port);
+            if (st != "active" && st != "deactivating") break;
+            msleep(100);
+            waited += 100;
+        }
+        if (active_state(in.port) == "active") {
+            fprintf(stderr, "instance_adjust: %s did not stop\n",
+                    in.name.c_str());
+            return false;
+        }
+        /* clear any failed remnant so a later re-add starts clean */
+        sysctl({"reset-failed", unit(in.port)}, nullptr);
+        unlink(dropin_file(in.port).c_str());
+        std::string tmp = dropin_file(in.port) + ".tmp";
+        unlink(tmp.c_str());
+        rmdir(dropin_dir(in.port).c_str());
+        reload_pending = true;
+        return true;
+    }
+
+    bool configure_instance(const Instance &in, bool *needs_restart,
+                            bool *noop) override {
+        Props current, desired = desired_props(in);
+        bool had = read_dropin(in.port, &current);
+        if (had && props_equal(current, desired)) {
+            *needs_restart = false;
+            *noop = true;
+            return true;
+        }
+        printf("%s %s\n", had ? "configure" : "create", in.name.c_str());
+        *changed = true;
+        *noop = false;
+        /* like the reference, only a *running* instance with changed
+         * config is restarted; stopped ones just start (running-snapshot
+         * compare, smf_adjust.c:384-448).  This includes a hand-started
+         * unit getting its first drop-in — its live environment is stale */
+        *needs_restart = active_state(in.port) == "active";
+        if (opt.dry_run) return true;
+        return write_dropin(in.port, desired);
+    }
+
+    bool ensure_running(const Instance &in, bool needs_restart) override {
+        if (opt.dry_run) {
+            if (needs_restart) {
+                printf("restart %s\n", in.name.c_str());
+                *changed = true;
+            } else if (active_state(in.port) != "active") {
+                printf("start %s\n", in.name.c_str());
+                *changed = true;
+            }
+            return true;
+        }
+        if (needs_restart) {
+            printf("restart %s\n", in.name.c_str());
+            *changed = true;
+            maybe_reload();
+            return sysctl({"restart", unit(in.port)}, nullptr) == 0;
+        }
+        std::string st = active_state(in.port);
+        if (st == "active") {
+            /* idempotent enable so the instance survives reboot (the
+             * reference's instances are persistently enabled) */
+            sysctl({"enable", unit(in.port)}, nullptr);
+            return true;
+        }
+        if (st == "failed") {
+            /* maintenance/degraded restore: clear restarter state first
+             * (flush_status, smfx.c:242-336) */
+            printf("restore %s\n", in.name.c_str());
+            *changed = true;
+            sysctl({"reset-failed", unit(in.port)}, nullptr);
+            maybe_reload();
+            sysctl({"enable", unit(in.port)}, nullptr);
+            return sysctl({"start", unit(in.port)}, nullptr) == 0;
+        }
+        printf("start %s\n", in.name.c_str());
+        *changed = true;
+        maybe_reload();
+        sysctl({"enable", unit(in.port)}, nullptr);
+        return sysctl({"start", unit(in.port)}, nullptr) == 0;
+    }
+
+    bool wait_online(const Instance &in) override {
+        int waited = 0;
+        std::string sock = socket_path(in.port);
+        while (waited < kOnlineWaitMs) {
+            bool active = active_state(in.port) == "active";
+            bool sock_ok = access(sock.c_str(), F_OK) == 0;
+            if (active && sock_ok) {
+                /* stability recheck, as in the statedir backend */
+                msleep(500);
+                if (active_state(in.port) == "active") return true;
+            }
+            if (active_state(in.port) == "failed") break;
+            msleep(200);
+            waited += 200;
+        }
+        fprintf(stderr, "instance_adjust: %s did not come online\n",
+                in.name.c_str());
+        return false;
+    }
+};
+
+/* ---- the shared plan/diff core (smf_adjust.c:866-1051) ---- */
+
+struct Reconciler {
+    Options opt;
+    ServiceManager *mgr;
+    std::vector<Instance> insts;
+    bool changed = false;
+
+    /* plan + walk (smf_adjust.c:964-1015) */
+    void build_sets() {
+        std::map<std::string, Instance> by_name;
+        for (int i = 0; i < opt.count; i++) {
+            Instance in;
+            in.port = opt.baseport + i;
+            in.name = opt.base + "-" + std::to_string(in.port);
+            in.planned = true;
+            by_name[in.name] = in;
+        }
+        for (const Instance &found : mgr->discover()) {
+            auto it = by_name.find(found.name);
+            if (it == by_name.end()) {
+                by_name[found.name] = found;   /* unwanted: removal mark */
+            } else {
+                it->second.exists = true;
+            }
+        }
+        for (auto &kv : by_name) insts.push_back(kv.second);
+    }
 
     int run() {
         build_sets();
@@ -385,28 +758,37 @@ struct Reconciler {
 
         /* removals first, to free ports/sockets (smf_adjust.c:1025-1039) */
         for (const auto &in : insts)
-            if (!in.planned) ok &= remove_instance(in);
+            if (!in.planned) ok &= mgr->remove_instance(in);
 
+        /* configure everything before starting anything, so backends can
+         * batch config reloads (ensure/configure then enable phasing,
+         * smf_adjust.c:1040-1090) */
+        struct Work { const Instance *in; bool needs_restart; bool noop; };
+        std::vector<Work> work;
         for (auto &in : insts) {
             if (!in.planned) continue;
-            bool needs_restart = false, noop = false;
-            if (!configure_instance(in, &needs_restart, &noop)) {
+            Work w = {&in, false, false};
+            if (!mgr->configure_instance(in, &w.needs_restart, &w.noop)) {
                 ok = false;
                 continue;
             }
-            if (needs_restart && !opt.dry_run) stop_instance(in);
-            if (!opt.dry_run) {
-                bool was_running =
-                    process_alive(read_pid(pid_file(in.name)));
-                ok &= ensure_running(in);
-                if (noop && was_running)
-                    printf("unchanged %s\n", in.name.c_str());
-            }
+            work.push_back(w);
         }
+        for (const auto &w : work) {
+            bool saved = changed;
+            changed = false;
+            ok &= mgr->ensure_running(*w.in, w.needs_restart);
+            bool acted = changed;
+            changed = saved || acted;
+            if (w.noop && !acted)
+                printf("unchanged %s\n", w.in->name.c_str());
+        }
+
+        mgr->finish();
 
         if (opt.wait_online && !opt.dry_run) {
             for (const auto &in : insts)
-                if (in.planned) ok &= wait_online(in);
+                if (in.planned) ok &= mgr->wait_online(in);
         }
 
         /* metric-ports re-publication hook (smf_adjust.c:1119-1136) */
@@ -428,9 +810,11 @@ struct Reconciler {
 int main(int argc, char **argv) {
     Options opt;
     int c;
-    while ((c = getopt(argc, argv, "s:b:B:i:e:d:r:wn")) != -1) {
+    while ((c = getopt(argc, argv, "m:s:D:b:B:i:e:d:r:wn")) != -1) {
         switch (c) {
+        case 'm': opt.manager = optarg; break;
         case 's': opt.statedir = optarg; break;
+        case 'D': opt.dropin_root = optarg; break;
         case 'b': opt.base = optarg; break;
         case 'B': opt.baseport = atoi(optarg); break;
         case 'i': opt.count = atoi(optarg); break;
@@ -441,14 +825,29 @@ int main(int argc, char **argv) {
         case 'n': opt.dry_run = true; break;
         default:
             fprintf(stderr,
-                    "usage: instance_adjust -s statedir -b base -B baseport "
-                    "-i count -e exec [-d sockdir] [-r cmd] [-w] [-n]\n");
+                    "usage: instance_adjust [-m auto|systemd|statedir] "
+                    "-s statedir | -D dropin-root -b base -B baseport "
+                    "-i count [-e exec] [-d sockdir] [-r cmd] [-w] [-n]\n");
             return 2;
         }
     }
-    if (opt.statedir.empty() || opt.count < 0 ||
-        (opt.exec_template.empty() && !opt.dry_run)) {
-        fprintf(stderr, "instance_adjust: -s, -i and -e are required "
+    if (opt.manager == "auto") {
+        /* an explicit -s statedir wins (existing callers: binder-topology,
+         * tests — auto must never redirect them onto the host's real
+         * systemd); otherwise systemd iff the system booted with it */
+        if (!opt.statedir.empty())
+            opt.manager = "statedir";
+        else
+            opt.manager = access("/run/systemd/system", F_OK) == 0
+                              ? "systemd" : "statedir";
+    }
+    if (opt.manager != "systemd" && opt.manager != "statedir") {
+        fprintf(stderr, "instance_adjust: unknown manager '%s'\n",
+                opt.manager.c_str());
+        return 2;
+    }
+    if (opt.count < 0) {
+        fprintf(stderr, "instance_adjust: -i is required "
                         "(max instances: 32, ports %d..%d)\n",
                 opt.baseport, opt.baseport + 31);
         return 2;
@@ -457,10 +856,23 @@ int main(int argc, char **argv) {
         fprintf(stderr, "instance_adjust: count > 32\n");
         return 2;
     }
-    mkdir(opt.statedir.c_str(), 0755);
-    if (!opt.sockdir.empty()) mkdir(opt.sockdir.c_str(), 0755);
 
     Reconciler rec;
     rec.opt = opt;
+    std::unique_ptr<ServiceManager> mgr;
+    if (opt.manager == "statedir") {
+        if (opt.statedir.empty() ||
+            (opt.exec_template.empty() && !opt.dry_run)) {
+            fprintf(stderr, "instance_adjust: -m statedir requires -s "
+                            "and -e\n");
+            return 2;
+        }
+        mkdir(opt.statedir.c_str(), 0755);
+        if (!opt.sockdir.empty()) mkdir(opt.sockdir.c_str(), 0755);
+        mgr.reset(new StatedirManager(opt, &rec.changed));
+    } else {
+        mgr.reset(new SystemdManager(opt, &rec.changed));
+    }
+    rec.mgr = mgr.get();
     return rec.run();
 }

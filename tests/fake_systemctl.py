@@ -1,0 +1,225 @@
+#!/usr/bin/env -S python3 -S -E
+"""A fake ``systemctl`` for exercising instance_adjust's systemd backend.
+
+Installed on PATH as ``systemctl`` by tests/test_instance_adjust_systemd.py.
+Keeps unit state in $FAKE_SYSTEMD_STATE:
+
+    log           one line per invocation (for command-protocol asserts)
+    units/<unit>  two lines: ``state=<active|inactive|failed>``,
+                  ``enabled=<0|1>``
+
+Behavioral model (the slice instance_adjust relies on):
+  - ``list-units`` shows loaded units — here: anything active or failed
+    (inactive disabled template instances are garbage-collected by real
+    systemd, so they vanish from listings the same way);
+  - ``list-unit-files`` shows enabled instances;
+  - ``start`` creates $FAKE_SOCKDIR/<port> when that env var is set (the
+    binder instance's balancer socket), ``stop`` removes it — so ``-w``
+    online-wait sees the real readiness signal;
+  - a ``fail-start`` marker file makes the next ``start`` land the unit in
+    ``failed`` (crash-on-startup simulation).
+"""
+import os
+import shlex
+import sys
+
+
+STATE = os.environ["FAKE_SYSTEMD_STATE"]
+UNITS = os.path.join(STATE, "units")
+
+
+def log(argv):
+    with open(os.path.join(STATE, "log"), "a") as f:
+        f.write(shlex.join(argv) + "\n")
+
+
+def unit_file(unit):
+    return os.path.join(UNITS, unit)
+
+
+def read_unit(unit):
+    try:
+        with open(unit_file(unit)) as f:
+            d = dict(line.strip().split("=", 1) for line in f if "=" in line)
+    except FileNotFoundError:
+        return {"state": "inactive", "enabled": "0", "known": False}
+    d.setdefault("state", "inactive")
+    d.setdefault("enabled", "0")
+    d["known"] = True
+    return d
+
+
+def write_unit(unit, d):
+    os.makedirs(UNITS, exist_ok=True)
+    with open(unit_file(unit), "w") as f:
+        f.write(f"state={d['state']}\nenabled={d['enabled']}\n")
+
+
+def unit_port(unit):
+    # binder@5301.service -> 5301
+    if "@" not in unit:
+        return None
+    tail = unit.split("@", 1)[1]
+    tail = tail[:-len(".service")] if tail.endswith(".service") else tail
+    return tail if tail.isdigit() else None
+
+
+def touch_socket(unit, create):
+    sockdir = os.environ.get("FAKE_SOCKDIR")
+    port = unit_port(unit)
+    if not sockdir or port is None:
+        return
+    path = os.path.join(sockdir, port)
+    if create:
+        os.makedirs(sockdir, exist_ok=True)
+        with open(path, "w"):
+            pass
+    else:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+
+def do_start(unit):
+    d = read_unit(unit)
+    if os.path.exists(os.path.join(STATE, "fail-start")):
+        d["state"] = "failed"
+        write_unit(unit, d)
+        touch_socket(unit, create=False)
+        return 1
+    d["state"] = "active"
+    write_unit(unit, d)
+    touch_socket(unit, create=True)
+    return 0
+
+
+def gc_unit(unit):
+    """Real systemd unloads (forgets) template instances that are
+    inactive, disabled, and have no drop-in config."""
+    d = read_unit(unit)
+    if d["known"] and d["state"] == "inactive" and d["enabled"] == "0":
+        os.unlink(unit_file(unit))
+
+
+def do_stop(unit):
+    d = read_unit(unit)
+    if d["state"] == "active":
+        d["state"] = "inactive"
+        write_unit(unit, d)
+    touch_socket(unit, create=False)
+    gc_unit(unit)
+    return 0
+
+
+def match(unit, pattern):
+    import fnmatch
+    return fnmatch.fnmatch(unit, pattern)
+
+
+def main(argv):
+    log(argv)
+    cmd, rest = argv[0], argv[1:]
+    flags = [a for a in rest if a.startswith("-")]
+    args = [a for a in rest if not a.startswith("-")]
+
+    if cmd == "daemon-reload":
+        return 0
+
+    if cmd in ("list-units", "list-unit-files"):
+        pattern = args[0] if args else "*"
+        rows = []
+        if os.path.isdir(UNITS):
+            for unit in sorted(os.listdir(UNITS)):
+                if not match(unit, pattern):
+                    continue
+                d = read_unit(unit)
+                if cmd == "list-units" and d["state"] in ("active", "failed"):
+                    sub = "running" if d["state"] == "active" else "failed"
+                    rows.append(f"{unit} loaded {d['state']} {sub}")
+                elif cmd == "list-unit-files" and d["enabled"] == "1":
+                    rows.append(f"{unit} enabled")
+        print("\n".join(rows))
+        return 0
+
+    if cmd == "show":
+        # show -p ActiveState --value <unit> — "-p ActiveState" puts the
+        # property name in args, so the unit is the final argument
+        print(read_unit(args[-1])["state"])
+        return 0
+
+    if cmd == "is-active":
+        d = read_unit(args[0])
+        if "--quiet" not in flags:
+            print(d["state"])
+        return 0 if d["state"] == "active" else 3
+
+    if cmd == "is-failed":
+        d = read_unit(args[0])
+        if "--quiet" not in flags:
+            print(d["state"])
+        return 0 if d["state"] == "failed" else 1
+
+    if cmd == "enable":
+        for unit in args:
+            d = read_unit(unit)
+            d["enabled"] = "1"
+            write_unit(unit, d)
+            if "--now" in flags:
+                do_start(unit)
+        return 0
+
+    if cmd == "disable":
+        rc = 0
+        for unit in args:
+            d = read_unit(unit)
+            d["enabled"] = "0"
+            write_unit(unit, d)
+            if "--now" in flags:
+                rc |= do_stop(unit)
+            else:
+                gc_unit(unit)
+        return rc
+
+    if cmd == "start":
+        rc = 0
+        for unit in args:
+            rc |= do_start(unit)
+        return rc
+
+    if cmd == "stop":
+        rc = 0
+        for unit in args:
+            rc |= do_stop(unit)
+        return rc
+
+    if cmd == "restart":
+        rc = 0
+        for unit in args:
+            do_stop(unit)
+            rc |= do_start(unit)
+        return rc
+
+    if cmd == "try-restart":
+        rc = 0
+        for unit in args:
+            if read_unit(unit)["state"] == "active":
+                do_stop(unit)
+                rc |= do_start(unit)
+        return rc
+
+    if cmd == "reset-failed":
+        for unit in args:
+            d = read_unit(unit)
+            if d["state"] == "failed":
+                d["state"] = "inactive"
+                write_unit(unit, d)
+            gc_unit(unit)
+        return 0
+
+    print(f"fake systemctl: unknown command {cmd}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,267 @@
+"""End-to-end tests for instance_adjust's systemd backend (-m systemd).
+
+The reconciler drives the shipped deploy/systemd/binder@.service template
+units through systemctl (ref: smf_adjust against libscf,
+src/smf_adjust.c:866-931).  The container has no booted systemd, so these
+tests install tests/fake_systemctl.py on PATH as ``systemctl`` and assert
+both the resulting unit state and the exact command protocol: enable/start
+on create, drop-in no-op detection, restart-only-when-running on config
+change, disable-->wait-->delete on removal, and reset-failed + start as the
+maintenance-restore analog (flush_status, src/smfx.c:242-336).
+"""
+import os
+import shutil
+import stat
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ADJUST = os.path.join(ROOT, "native", "build", "instance_adjust")
+FAKE = os.path.join(ROOT, "tests", "fake_systemctl.py")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(ADJUST),
+    reason="instance_adjust not built (make -C native)")
+
+
+@pytest.fixture
+def sd(tmp_path):
+    """Fake-systemd environment: PATH shim + state/dropin/socket dirs."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    shim = bindir / "systemctl"
+    shutil.copy(FAKE, shim)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR)
+
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["FAKE_SYSTEMD_STATE"] = str(tmp_path / "sysd")
+    env["FAKE_SOCKDIR"] = str(tmp_path / "sockets")
+    (tmp_path / "sysd").mkdir()
+
+    class Env:
+        dropins = tmp_path / "dropins"
+        sockets = tmp_path / "sockets"
+        state = tmp_path / "sysd"
+
+        def adjust(self, count, base="binder", baseport=5301, extra=None,
+                   expect_rc=0):
+            cmd = [ADJUST, "-m", "systemd", "-D", str(self.dropins),
+                   "-b", base, "-B", str(baseport), "-i", str(count),
+                   "-d", str(self.sockets)]
+            cmd += extra or []
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=60, env=env)
+            assert proc.returncode == expect_rc, (proc.stdout, proc.stderr)
+            return proc.stdout.splitlines()
+
+        def log(self):
+            try:
+                with open(self.state / "log") as f:
+                    return f.read().splitlines()
+            except FileNotFoundError:
+                return []
+
+        def clear_log(self):
+            (self.state / "log").write_text("")
+
+        def unit_state(self, unit):
+            try:
+                with open(self.state / "units" / unit) as f:
+                    return dict(line.strip().split("=", 1) for line in f)
+            except FileNotFoundError:
+                return None
+
+        def set_unit_state(self, unit, state, enabled="1"):
+            (self.state / "units").mkdir(exist_ok=True)
+            (self.state / "units" / unit).write_text(
+                f"state={state}\nenabled={enabled}\n")
+
+        def dropin(self, port, base="binder"):
+            path = (self.dropins / f"{base}@{port}.service.d"
+                    / "50-instance.conf")
+            try:
+                return path.read_text()
+            except FileNotFoundError:
+                return None
+
+    e = Env()
+    e.env = env
+    e.dropins.mkdir()
+    return e
+
+
+def test_create_enables_and_starts(sd):
+    out = sd.adjust(2)
+    assert "create binder-5301" in out and "create binder-5302" in out
+    assert "start binder-5301" in out and "start binder-5302" in out
+    for port in (5301, 5302):
+        unit = f"binder@{port}.service"
+        st = sd.unit_state(unit)
+        assert st == {"state": "active", "enabled": "1"}
+        conf = sd.dropin(port)
+        assert f"Environment=BINDER_PORT={port}" in conf
+        assert (f"Environment=BINDER_SOCKET_PATH={sd.sockets}/{port}"
+                in conf)
+        # the fake's start created the balancer socket
+        assert (sd.sockets / str(port)).exists()
+    log = sd.log()
+    # drop-in edits must be followed by exactly one daemon-reload, and it
+    # must precede the first start
+    reloads = [i for i, l in enumerate(log) if l == "daemon-reload"]
+    starts = [i for i, l in enumerate(log) if l.startswith("start ")]
+    assert len(reloads) == 1 and starts and reloads[0] < starts[0]
+
+
+def test_converged_run_is_noop(sd):
+    sd.adjust(2)
+    sd.clear_log()
+    out = sd.adjust(2)
+    assert "unchanged binder-5301" in out and "unchanged binder-5302" in out
+    log = sd.log()
+    for verb in ("start", "stop", "restart", "disable", "daemon-reload"):
+        assert not any(l.startswith(verb) for l in log), log
+
+
+def test_scale_down_disables_and_forgets(sd):
+    sd.adjust(3)
+    sd.clear_log()
+    out = sd.adjust(1)
+    assert "remove binder-5302" in out and "remove binder-5303" in out
+    log = sd.log()
+    assert "disable --now binder@5302.service" in log
+    assert "disable --now binder@5303.service" in log
+    assert "reset-failed binder@5302.service" in log
+    for port in (5302, 5303):
+        assert sd.dropin(port) is None
+        assert sd.unit_state(f"binder@{port}.service") is None  # forgotten
+    # survivor untouched
+    assert sd.unit_state("binder@5301.service")["state"] == "active"
+    assert "unchanged binder-5301" in out
+
+
+def test_config_change_restarts_only_running(sd):
+    sd.adjust(2)
+    # stop 5302 behind the reconciler's back
+    sd.set_unit_state("binder@5302.service", "inactive")
+    sd.clear_log()
+    # change the socket dir => every drop-in differs
+    sd.sockets = sd.sockets.parent / "sockets2"
+    out = sd.adjust(2)
+    assert "configure binder-5301" in out and "configure binder-5302" in out
+    log = sd.log()
+    # running instance: restart (running-snapshot compare,
+    # smf_adjust.c:384-448); stopped instance: plain start
+    assert "restart binder@5301.service" in log
+    assert "start binder@5302.service" in log
+    assert "restart binder@5302.service" not in log
+    assert "Environment=BINDER_SOCKET_PATH=" + str(sd.sockets) + "/5301" \
+        in sd.dropin(5301)
+
+
+def test_restore_from_failed(sd):
+    sd.adjust(1)
+    sd.set_unit_state("binder@5301.service", "failed")
+    sd.clear_log()
+    out = sd.adjust(1)
+    assert "restore binder-5301" in out
+    log = sd.log()
+    ir = log.index("reset-failed binder@5301.service")
+    assert any(l == "start binder@5301.service" for l in log[ir:])
+    assert sd.unit_state("binder@5301.service")["state"] == "active"
+
+
+def test_foreign_instance_sets_untouched(sd):
+    # same-prefix different base, and a non-numeric instance
+    sd.set_unit_state("binder-blue@6001.service", "active")
+    sd.set_unit_state("binder@abc.service", "active")
+    sd.adjust(1)
+    assert sd.unit_state("binder-blue@6001.service")["state"] == "active"
+    assert sd.unit_state("binder@abc.service")["state"] == "active"
+    log = sd.log()
+    assert not any("binder-blue@" in l or "binder@abc" in l
+                   for l in log if not l.startswith("list-"))
+
+
+def test_wait_online_uses_socket(sd):
+    out = sd.adjust(2, extra=["-w"])
+    assert "start binder-5301" in out
+    # -w returned success only because the sockets appeared
+    assert (sd.sockets / "5301").exists() and (sd.sockets / "5302").exists()
+
+
+def test_wait_online_fails_on_crashed_instance(sd):
+    (sd.state / "fail-start").write_text("")
+    sd.adjust(1, extra=["-w"], expect_rc=1)
+    assert sd.unit_state("binder@5301.service")["state"] == "failed"
+
+
+def test_refresh_hook_runs_once_on_change_only(sd, tmp_path):
+    marker = tmp_path / "refreshed"
+    hook = f"date >> {marker}"
+    out = sd.adjust(2, extra=["-r", hook])
+    assert "refresh-hook" in out
+    assert len(marker.read_text().splitlines()) == 1
+    out = sd.adjust(2, extra=["-r", hook])
+    assert "refresh-hook" not in out
+    assert len(marker.read_text().splitlines()) == 1
+
+
+def test_dry_run_mutates_nothing(sd):
+    out = sd.adjust(2, extra=["-n"])
+    assert "create binder-5301" in out and "start binder-5301" in out
+    assert sd.dropin(5301) is None
+    assert sd.unit_state("binder@5301.service") is None
+    log = sd.log()
+    assert all(l.startswith(("list-", "show")) for l in log), log
+
+
+def test_hand_started_unit_gets_dropin_and_restart(sd):
+    # a unit someone started by hand runs with the unit-file default
+    # environment; its first drop-in must restart it, or it keeps serving
+    # on the stale socket path
+    sd.set_unit_state("binder@5301.service", "active")
+    sd.clear_log()
+    out = sd.adjust(1)
+    assert "create binder-5301" in out
+    assert "restart binder@5301.service" in sd.log()
+
+
+def test_removal_only_converge_still_reloads(sd):
+    sd.adjust(2)
+    sd.clear_log()
+    sd.adjust(1)
+    # no start/restart happened for the survivor, but the deleted drop-in
+    # must still be flushed from systemd's cache
+    log = sd.log()
+    assert "daemon-reload" in log
+    assert not any(l.startswith(("start ", "restart ")) for l in log)
+
+
+def test_auto_with_statedir_never_touches_systemd(sd, tmp_path):
+    # -m auto with an explicit -s must select the statedir backend even
+    # where systemd is running; otherwise binder-topology on a systemd
+    # host would reconcile the host's real units
+    statedir = tmp_path / "state"
+    cmd = [ADJUST, "-s", str(statedir), "-b", "binder", "-B", "5301",
+           "-i", "1", "-e", "sleep 300"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=60,
+                          env=sd.env)
+    try:
+        assert proc.returncode == 0, proc.stderr
+        assert (statedir / "binder-5301.props").exists()
+        assert sd.log() == []   # no systemctl invocation at all
+    finally:
+        subprocess.run([ADJUST, "-s", str(statedir), "-b", "binder",
+                        "-B", "5301", "-i", "0", "-e", "sleep 300"],
+                       timeout=60, env=sd.env)
+
+
+def test_discovery_via_enabled_units_without_dropin(sd):
+    # an instance someone enabled by hand (no drop-in) is still discovered
+    # and reconciled away when unplanned
+    sd.set_unit_state("binder@5399.service", "active", enabled="1")
+    out = sd.adjust(1)
+    assert "remove binder-5399" in out
+    assert sd.unit_state("binder@5399.service") is None
