@@ -7,10 +7,20 @@ client uses: session handshake/resume/expiry, ping, getChildren2,
 getData, exists (all with one-shot watches), create, setData, delete,
 closeSession.
 
-Not a replicated store: state is a single in-memory tree.  Production
-deployments point ``store.backend=zookeeper`` at a real ensemble; this
-server exists so the protocol path has automated coverage the reference
-never had (its tests require a live ZK at 127.0.0.1:2181, SURVEY §4).
+Ensemble semantics: several servers constructed over one shared
+``ZKEnsembleState`` behave like members of a quorum from the client's
+point of view — the tree, the session table, and zxids are common, so a
+session established through one member survives a failover to another
+(the ZAB-replicated-session behavior of the production co-located
+ensemble, reference README.md:36-39).  Watch registrations also live in
+the shared state; combined with the client's re-arm-on-reconnect pass
+this makes the failover path testable end to end.  Each server only
+severs its *own* connections on stop(), exactly like losing one member.
+
+Production deployments point ``store.backend=zookeeper`` at a real
+ensemble; this server exists so the protocol path has automated coverage
+the reference never had (its tests require a live ZK at 127.0.0.1:2181,
+SURVEY §4).
 """
 from __future__ import annotations
 
@@ -43,19 +53,33 @@ class _Session:
         self.expired = False
 
 
+class ZKEnsembleState:
+    """State shared by every member of a test ensemble: the replicated
+    tree, the session table, the zxid counter, and watch registrations
+    (path -> set of session ids, per watch class)."""
+
+    def __init__(self) -> None:
+        self.root = _Node()
+        self.sessions: Dict[int, _Session] = {}
+        self.next_session = 0x10_0000_0000_0001
+        self.zxid = 0
+        self.data_watches: Dict[str, Set[int]] = {}
+        self.child_watches: Dict[str, Set[int]] = {}
+        self.exists_watches: Dict[str, Set[int]] = {}
+
+
 class ZKTestServer:
-    def __init__(self, log: Optional[logging.Logger] = None) -> None:
+    def __init__(self, log: Optional[logging.Logger] = None,
+                 state: Optional[ZKEnsembleState] = None) -> None:
         self.log = log or logging.getLogger("binder.zktest")
-        self._root = _Node()
-        self._sessions: Dict[int, _Session] = {}
-        self._next_session = 0x10_0000_0000_0001
-        self._zxid = 0
+        # pass the same ZKEnsembleState to several servers to model a
+        # quorum; default is a standalone single-member "ensemble"
+        self.state = state if state is not None else ZKEnsembleState()
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
-        # watches: path -> set of session ids, per watch class
-        self._data_watches: Dict[str, Set[int]] = {}
-        self._child_watches: Dict[str, Set[int]] = {}
-        self._exists_watches: Dict[str, Set[int]] = {}
+        # connections accepted by THIS member (stop() must only sever
+        # these, not sessions served by sibling members)
+        self._conns: Set[asyncio.StreamWriter] = set()
         self.dropped_conns = 0
 
     # -- lifecycle --
@@ -69,10 +93,11 @@ class ZKTestServer:
         # sever live connections BEFORE wait_closed(): since 3.12 it
         # waits for connection handlers too, and a handler blocked in a
         # read only exits once its writer (same transport) is closed —
-        # the old order deadlocked when a client was still connected
-        for s in self._sessions.values():
-            if s.writer is not None:
-                s.writer.close()
+        # the old order deadlocked when a client was still connected.
+        # Only THIS member's connections are severed; sessions survive in
+        # the shared state for the surviving members to resume.
+        for w in list(self._conns):
+            w.close()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -80,23 +105,23 @@ class ZKTestServer:
     def expire_session(self, session_id: Optional[int] = None) -> None:
         """Mark session(s) expired and drop their connections — the test
         hook for session-loss behavior."""
-        for s in list(self._sessions.values()):
+        for s in list(self.state.sessions.values()):
             if session_id is None or s.id == session_id:
                 s.expired = True
                 if s.writer is not None:
                     s.writer.close()
 
     def drop_connections(self) -> None:
-        """Sever connections without expiring sessions (network blip)."""
-        for s in self._sessions.values():
-            if s.writer is not None:
-                self.dropped_conns += 1
-                s.writer.close()
+        """Sever this member's connections without expiring sessions
+        (network blip)."""
+        for w in list(self._conns):
+            self.dropped_conns += 1
+            w.close()
 
     # -- tree helpers --
 
     def _find(self, path: str) -> Optional[_Node]:
-        node = self._root
+        node = self.state.root
         for part in [p for p in path.split("/") if p]:
             node = node.children.get(part)
             if node is None:
@@ -113,12 +138,13 @@ class ZKTestServer:
     def _fire(self, table: Dict[str, Set[int]], path: str,
               etype: int) -> None:
         sessions = table.pop(path, set())
-        payload = (jute.i32(jute.XID_WATCHER_EVENT) + jute.i64(self._zxid)
+        payload = (jute.i32(jute.XID_WATCHER_EVENT)
+                   + jute.i64(self.state.zxid)
                    + jute.i32(0) + jute.i32(etype)
                    + jute.i32(KeeperState.SYNC_CONNECTED)
                    + jute.string(path))
         for sid in sessions:
-            s = self._sessions.get(sid)
+            s = self.state.sessions.get(sid)
             if s is not None and s.writer is not None and not s.expired:
                 try:
                     s.writer.write(jute.frame(payload))
@@ -130,6 +156,7 @@ class ZKTestServer:
     async def _conn(self, reader: asyncio.StreamReader,
                     writer: asyncio.StreamWriter) -> None:
         session: Optional[_Session] = None
+        self._conns.add(writer)
         try:
             # handshake
             req = Buf(await self._read_frame(reader))
@@ -141,7 +168,7 @@ class ZKTestServer:
             # (optional readOnly flag ignored)
 
             if session_id != 0:
-                old = self._sessions.get(session_id)
+                old = self.state.sessions.get(session_id)
                 if old is None or old.expired:
                     # expired: per protocol, answer with session 0
                     writer.write(jute.frame(
@@ -151,9 +178,9 @@ class ZKTestServer:
                     return
                 session = old
             else:
-                session = _Session(self._next_session, timeout)
-                self._next_session += 1
-                self._sessions[session.id] = session
+                session = _Session(self.state.next_session, timeout)
+                self.state.next_session += 1
+                self.state.sessions[session.id] = session
             session.writer = writer
             writer.write(jute.frame(
                 jute.i32(0) + jute.i32(session.timeout_ms)
@@ -167,24 +194,25 @@ class ZKTestServer:
                 opcode = buf.i32()
                 if opcode == OpCode.PING:
                     writer.write(jute.frame(
-                        jute.i32(jute.XID_PING) + jute.i64(self._zxid)
+                        jute.i32(jute.XID_PING) + jute.i64(self.state.zxid)
                         + jute.i32(0)))
                     await writer.drain()
                     continue
                 if opcode == OpCode.CLOSE:
                     writer.write(jute.frame(
-                        jute.i32(xid) + jute.i64(self._zxid) + jute.i32(0)))
+                        jute.i32(xid) + jute.i64(self.state.zxid) + jute.i32(0)))
                     await writer.drain()
                     return
                 err, body = self._handle(session, opcode, buf)
                 writer.write(jute.frame(
-                    jute.i32(xid) + jute.i64(self._zxid) + jute.i32(err)
+                    jute.i32(xid) + jute.i64(self.state.zxid) + jute.i32(err)
                     + body))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 ValueError):
             pass
         finally:
+            self._conns.discard(writer)
             if session is not None and session.writer is writer:
                 session.writer = None
             writer.close()
@@ -206,11 +234,11 @@ class ZKTestServer:
             node = self._find(path)
             if node is None:
                 if watch:
-                    self._exists_watches.setdefault(path,
+                    self.state.exists_watches.setdefault(path,
                                                     set()).add(session.id)
                 return Err.NONODE, b""
             if watch:
-                self._child_watches.setdefault(path, set()).add(session.id)
+                self.state.child_watches.setdefault(path, set()).add(session.id)
             out = jute.i32(len(node.children))
             for name in sorted(node.children):
                 out += jute.string(name)
@@ -227,11 +255,11 @@ class ZKTestServer:
             node = self._find(path)
             if node is None:
                 if watch:
-                    self._exists_watches.setdefault(path,
+                    self.state.exists_watches.setdefault(path,
                                                     set()).add(session.id)
                 return Err.NONODE, b""
             if watch:
-                self._data_watches.setdefault(path, set()).add(session.id)
+                self.state.data_watches.setdefault(path, set()).add(session.id)
             return Err.OK, (jute.buffer(node.data)
                             + jute.pack_stat(version=node.version,
                                              data_length=len(node.data)))
@@ -242,11 +270,11 @@ class ZKTestServer:
             node = self._find(path)
             if node is None:
                 if watch:
-                    self._exists_watches.setdefault(path,
+                    self.state.exists_watches.setdefault(path,
                                                     set()).add(session.id)
                 return Err.NONODE, b""
             if watch:
-                self._data_watches.setdefault(path, set()).add(session.id)
+                self.state.data_watches.setdefault(path, set()).add(session.id)
             return Err.OK, jute.pack_stat(version=node.version,
                                           data_length=len(node.data))
 
@@ -259,11 +287,11 @@ class ZKTestServer:
                 return Err.NONODE, b""
             if name in parent.children:
                 return Err.NODEEXISTS, b""
-            self._zxid += 1
+            self.state.zxid += 1
             parent.children[name] = _Node(data)
             parent.cversion += 1
-            self._fire(self._exists_watches, path, EventType.CREATED)
-            self._fire(self._child_watches, parent_path,
+            self._fire(self.state.exists_watches, path, EventType.CREATED)
+            self._fire(self.state.child_watches, parent_path,
                        EventType.CHILDREN_CHANGED)
             return Err.OK, jute.string(path)
 
@@ -273,10 +301,10 @@ class ZKTestServer:
             node = self._find(path)
             if node is None:
                 return Err.NONODE, b""
-            self._zxid += 1
+            self.state.zxid += 1
             node.data = data
             node.version += 1
-            self._fire(self._data_watches, path, EventType.DATA_CHANGED)
+            self._fire(self.state.data_watches, path, EventType.DATA_CHANGED)
             return Err.OK, jute.pack_stat(version=node.version,
                                           data_length=len(data))
 
@@ -288,12 +316,12 @@ class ZKTestServer:
                 return Err.NONODE, b""
             if parent.children[name].children:
                 return Err.NOTEMPTY, b""
-            self._zxid += 1
+            self.state.zxid += 1
             del parent.children[name]
             parent.cversion += 1
-            self._fire(self._data_watches, path, EventType.DELETED)
-            self._fire(self._child_watches, path, EventType.DELETED)
-            self._fire(self._child_watches, parent_path,
+            self._fire(self.state.data_watches, path, EventType.DELETED)
+            self._fire(self.state.child_watches, path, EventType.DELETED)
+            self._fire(self.state.child_watches, parent_path,
                        EventType.CHILDREN_CHANGED)
             return Err.OK, b""
 

@@ -233,7 +233,16 @@ bool parse_port_tail(const std::string &tail, int *port) {
     if (tail.empty() ||
         tail.find_first_not_of("0123456789") != std::string::npos)
         return false;
-    *port = atoi(tail.c_str());
+    errno = 0;
+    char *end = nullptr;
+    long v = strtol(tail.c_str(), &end, 10);
+    if (errno != 0 || *end != '\0' || v < 0 || v > 65535)
+        return false;
+    /* the round-trip check rejects leading zeros (binder@007), whose
+     * port would map back to a differently-named unit */
+    if (std::to_string(v) != tail)
+        return false;
+    *port = (int)v;
     return true;
 }
 
@@ -249,7 +258,10 @@ struct ServiceManager {
     /* write desired config; *noop=true if identical (nvlist_equal path) */
     virtual bool configure_instance(const Instance &in, bool *needs_restart,
                                     bool *noop) = 0;
-    virtual bool ensure_running(const Instance &in, bool needs_restart) = 0;
+    /* *acted reports whether anything was done (drives both the
+     * refresh hook and the "unchanged" no-op report) */
+    virtual bool ensure_running(const Instance &in, bool needs_restart,
+                                bool *acted) = 0;
     virtual bool wait_online(const Instance &in) = 0;
     /* end-of-run hook (e.g. flush a pending config reload after a
      * removal-only converge) */
@@ -372,7 +384,6 @@ struct StatedirManager : ServiceManager {
 
     bool start_instance(const Instance &in) {
         printf("start %s\n", in.name.c_str());
-        *changed = true;
         if (opt.dry_run) return true;
         Props props;
         read_props(props_file(in.name), &props);
@@ -410,8 +421,12 @@ struct StatedirManager : ServiceManager {
     }
 
     /* enable + restore (smf_adjust.c:457-544; flush_status analog) */
-    bool ensure_running(const Instance &in, bool needs_restart) override {
-        if (needs_restart && !opt.dry_run) stop_instance(in);
+    bool ensure_running(const Instance &in, bool needs_restart,
+                        bool *acted) override {
+        if (needs_restart && !opt.dry_run) {
+            stop_instance(in);
+            *acted = true;
+        }
         pid_t pid = read_pid(pid_file(in.name));
         if (process_alive(pid)) return true;
         if (pid > 0) {
@@ -420,6 +435,7 @@ struct StatedirManager : ServiceManager {
             printf("restore %s\n", in.name.c_str());
             if (!opt.dry_run) unlink(pid_file(in.name).c_str());
         }
+        *acted = true;
         return start_instance(in);
     }
 
@@ -619,13 +635,16 @@ struct SystemdManager : ServiceManager {
         if (opt.dry_run) return true;
         sysctl({"disable", "--now", unit(in.port)}, nullptr);
         int waited = 0;
+        std::string st;
         while (waited < kStopWaitMs) {
-            std::string st = active_state(in.port);
+            st = active_state(in.port);
             if (st != "active" && st != "deactivating") break;
             msleep(100);
             waited += 100;
         }
-        if (active_state(in.port) == "active") {
+        if (st == "active" || st == "deactivating") {
+            /* still (de)activating after the poll bound: the process may
+             * hold the port/socket — fail like the statedir backend */
             fprintf(stderr, "instance_adjust: %s did not stop\n",
                     in.name.c_str());
             return false;
@@ -661,20 +680,21 @@ struct SystemdManager : ServiceManager {
         return write_dropin(in.port, desired);
     }
 
-    bool ensure_running(const Instance &in, bool needs_restart) override {
+    bool ensure_running(const Instance &in, bool needs_restart,
+                        bool *acted) override {
         if (opt.dry_run) {
             if (needs_restart) {
                 printf("restart %s\n", in.name.c_str());
-                *changed = true;
+                *acted = true;
             } else if (active_state(in.port) != "active") {
                 printf("start %s\n", in.name.c_str());
-                *changed = true;
+                *acted = true;
             }
             return true;
         }
         if (needs_restart) {
             printf("restart %s\n", in.name.c_str());
-            *changed = true;
+            *acted = true;
             maybe_reload();
             return sysctl({"restart", unit(in.port)}, nullptr) == 0;
         }
@@ -689,14 +709,14 @@ struct SystemdManager : ServiceManager {
             /* maintenance/degraded restore: clear restarter state first
              * (flush_status, smfx.c:242-336) */
             printf("restore %s\n", in.name.c_str());
-            *changed = true;
+            *acted = true;
             sysctl({"reset-failed", unit(in.port)}, nullptr);
             maybe_reload();
             sysctl({"enable", unit(in.port)}, nullptr);
             return sysctl({"start", unit(in.port)}, nullptr) == 0;
         }
         printf("start %s\n", in.name.c_str());
-        *changed = true;
+        *acted = true;
         maybe_reload();
         sysctl({"enable", unit(in.port)}, nullptr);
         return sysctl({"start", unit(in.port)}, nullptr) == 0;
@@ -706,14 +726,15 @@ struct SystemdManager : ServiceManager {
         int waited = 0;
         std::string sock = socket_path(in.port);
         while (waited < kOnlineWaitMs) {
-            bool active = active_state(in.port) == "active";
+            std::string st = active_state(in.port);
             bool sock_ok = access(sock.c_str(), F_OK) == 0;
-            if (active && sock_ok) {
+            if (st == "active" && sock_ok) {
                 /* stability recheck, as in the statedir backend */
                 msleep(500);
                 if (active_state(in.port) == "active") return true;
+                st = "unknown";
             }
-            if (active_state(in.port) == "failed") break;
+            if (st == "failed") break;
             msleep(200);
             waited += 200;
         }
@@ -775,11 +796,10 @@ struct Reconciler {
             work.push_back(w);
         }
         for (const auto &w : work) {
-            bool saved = changed;
-            changed = false;
-            ok &= mgr->ensure_running(*w.in, w.needs_restart);
-            bool acted = changed;
-            changed = saved || acted;
+            bool acted = false;
+            ok &= mgr->ensure_running(*w.in, w.needs_restart, &acted);
+            if (acted)
+                changed = true;
             if (w.noop && !acted)
                 printf("unchanged %s\n", w.in->name.c_str());
         }

@@ -173,14 +173,18 @@ def test_restore_from_failed(sd):
 
 
 def test_foreign_instance_sets_untouched(sd):
-    # same-prefix different base, and a non-numeric instance
+    # same-prefix different base, a non-numeric instance, and a
+    # leading-zero tail (its parsed port would name a different unit)
     sd.set_unit_state("binder-blue@6001.service", "active")
     sd.set_unit_state("binder@abc.service", "active")
+    sd.set_unit_state("binder@007.service", "active")
     sd.adjust(1)
     assert sd.unit_state("binder-blue@6001.service")["state"] == "active"
     assert sd.unit_state("binder@abc.service")["state"] == "active"
+    assert sd.unit_state("binder@007.service")["state"] == "active"
     log = sd.log()
     assert not any("binder-blue@" in l or "binder@abc" in l
+                   or "binder@007" in l or "binder@7.service" in l
                    for l in log if not l.startswith("list-"))
 
 
