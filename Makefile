@@ -3,7 +3,7 @@
 
 PY ?= python3
 
-.PHONY: all native test check bench clean
+.PHONY: all native test check ci bench bench-smoke clean
 
 all: native
 
@@ -13,17 +13,41 @@ native:
 test: native
 	$(PY) -m pytest tests/ -q
 
-# style/consistency gate (the reference's `make check` runs jsstyle/jsl;
-# here: byte-compile everything, keep the native build warning-clean
-# (-B: a stale object must not mask a warning), and smoke the
-# sanitizer-built fuzzers over the native parsers)
+# style/consistency gate (the reference's `make check` runs the vendored
+# jsstyle/javascriptlint, reference Jenkinsfile:37-40; here: byte-compile
+# everything, a first-party zero-warning Python lint (tools/lint.py),
+# keep the native build warning-clean (-B: a stale object must not mask
+# a warning), smoke the sanitizer-built fuzzers over the native parsers,
+# and run the fastio pytest suites against the ASan-built extension)
 check:
 	$(PY) -m compileall -q binder_tpu tests bench.py bench_impl.py \
 		__graft_entry__.py
+	$(PY) tools/lint.py
 	$(MAKE) -B -C native \
 		CXXFLAGS="-O2 -g -Wall -Wextra -Werror -std=c++17" \
 		CFLAGS="-O2 -g -Wall -Wextra -Werror"
 	$(MAKE) -C native fuzz-smoke
+	$(MAKE) -C native check-asan
+
+# the reference's Jenkins pipeline as one invocable unit
+# (Jenkinsfile:25-41: checkout -> check -> [test]); extended with the
+# gates the reference leaves to production: full test suite + bench
+# smoke.  Explicitly sequential: check's ASan extension swap must not
+# race test's pytest import under `make -j`.
+ci:
+	$(MAKE) check
+	$(MAKE) test
+	$(MAKE) bench-smoke
+	@echo "ci: all gates passed"
+
+# one fast reduced-iteration bench pass proving the measured paths still
+# run end to end (its numbers are not comparable: small samples, and the
+# baseline write is diverted); the driver runs the full bench.py separately
+bench-smoke: native
+	@mkdir -p .scratch
+	BENCH_QUERIES=5000 BENCH_PASSES=1 BENCH_MISS_QUERIES=2000 \
+		BENCH_BASELINE_FILE=.scratch/bench_smoke_baseline.json \
+		$(PY) bench.py
 
 bench: native
 	$(PY) bench.py

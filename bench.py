@@ -18,7 +18,6 @@ reference serves from its ZK mirror.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 

@@ -36,7 +36,10 @@ N_PASSES = int(os.environ.get("BENCH_PASSES", "3"))
 # miss axis: distinct names, each queried exactly once (cache-cold)
 N_MISS = int(os.environ.get("BENCH_MISS_QUERIES", "20000"))
 CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "64"))
-BASELINE_FILE = os.path.join(ROOT, "BENCH_BASELINE.json")
+# overridable so `make bench-smoke` (reduced iteration CI gate) can't
+# pollute the persisted baseline with small-sample figures
+BASELINE_FILE = os.environ.get(
+    "BENCH_BASELINE_FILE", os.path.join(ROOT, "BENCH_BASELINE.json"))
 
 # query mix mirroring BASELINE.json's proxy configs; shared by the native
 # and Python load drivers so both measure the same workload

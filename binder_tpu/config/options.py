@@ -22,7 +22,7 @@ from __future__ import annotations
 import getopt
 import json
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 DEFAULTS: Dict[str, object] = {
     "expiry": 60000,

@@ -16,13 +16,11 @@ once; the server engine then emits the ``after`` event for metrics/logging
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from binder_tpu.dns.wire import (
     Message,
-    Opcode,
     OPTRecord,
-    Rcode,
     Record,
     Type,
 )

@@ -14,7 +14,7 @@ import logging
 import os
 import signal
 import sys
-from typing import Dict, Optional
+from typing import Dict
 
 from binder_tpu.config.options import ConfigError, parse_options
 from binder_tpu.metrics.collector import MetricsCollector, MetricsServer

@@ -22,7 +22,7 @@ Correctness properties:
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 
 class AnswerCache:

@@ -25,7 +25,7 @@ Semantics modeled on zkstream's surface as consumed by the cache:
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 
 class Watcher:

@@ -9,7 +9,7 @@ reference ``Makefile:75-77``).
 from __future__ import annotations
 
 import struct
-from typing import List, Optional, Tuple
+from typing import Optional
 
 
 class OpCode:

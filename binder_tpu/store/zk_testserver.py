@@ -27,8 +27,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
-import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from binder_tpu.store import jute
 from binder_tpu.store.jute import Buf, Err, EventType, KeeperState, OpCode

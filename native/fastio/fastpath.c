@@ -11,6 +11,11 @@
  * engine (binder_tpu/resolver/engine.py) and pushes the completed,
  * fully-encoded response variants down with `fastpath_put`.
  *
+ * The cache/serve core itself is Python-free and lives in fpcore.h (also
+ * driven by the sanitized fuzz target native/fuzz/fuzz_fastpath.cpp);
+ * this file is the CPython glue: capsule lifecycle, argument validation,
+ * recvmmsg/sendmmsg batching, stats marshaling.
+ *
  * Semantics preserved relative to the Python hit path
  * (BinderServer._on_query):
  *  - the key covers exactly the decoded fields the response depends on:
@@ -52,103 +57,16 @@
 
 #include "../common/dnskey.h"
 #include "fastpath.h"
+#include "fpcore.h"
 
 #define FP_BATCH FASTIO_BATCH
-#define FP_MAX_VARIANTS 8
-#define FP_PROBE 8
-#define FP_MAX_WIRE 4096          /* larger responses stay in Python */
-#define FP_MAX_KEY DNSKEY_MAX
-#define FP_MAX_QTYPES 16
-#define FP_MAX_BUCKETS 24
-#define FP_MAX_TOTAL_BYTES (64u << 20)
-#define FP_QTYPE_OTHER 0xFFFF     /* stats catch-all past FP_MAX_QTYPES */
-
-typedef struct {
-    uint8_t key[FP_MAX_KEY];
-    uint16_t keylen;
-    uint64_t gen;
-    double expire_at;
-    double inserted_at;
-    uint8_t n_variants;
-    uint8_t next_variant;
-    uint16_t qtype;
-    uint8_t *wires[FP_MAX_VARIANTS];
-    uint16_t wire_lens[FP_MAX_VARIANTS];
-    int used;
-} fp_entry_t;
-
-typedef struct {
-    uint16_t qtype;
-    uint64_t count;
-    double lat_sum;
-    double size_sum;
-    uint64_t lat_cells[FP_MAX_BUCKETS + 1];
-    uint64_t size_cells[FP_MAX_BUCKETS + 1];
-} fp_qstat_t;
-
-typedef struct {
-    fp_entry_t *slots;
-    uint32_t mask;            /* slot count - 1 (power of two) */
-    uint32_t n_entries;
-    uint64_t total_bytes;     /* wire bytes held */
-    double expiry_s;
-    double lat_buckets[FP_MAX_BUCKETS];
-    int n_lat_buckets;
-    double size_buckets[FP_MAX_BUCKETS];
-    int n_size_buckets;
-    fp_qstat_t qstats[FP_MAX_QTYPES];
-    int n_qstats;
-    uint64_t hits;
-    uint64_t lookups;
-} fp_cache_t;
 
 static const char *FP_CAPSULE_NAME = "binder_tpu._binderfastio.fastpath";
-
-static double
-fp_now(void)
-{
-    struct timespec ts;
-    clock_gettime(CLOCK_MONOTONIC, &ts);
-    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
-}
-
-static uint64_t
-fp_hash(const uint8_t *key, size_t len)
-{
-    uint64_t h = 1469598103934665603ull;        /* FNV-1a 64 */
-    for (size_t i = 0; i < len; i++) {
-        h ^= key[i];
-        h *= 1099511628211ull;
-    }
-    return h;
-}
-
-static void
-fp_entry_free(fp_cache_t *c, fp_entry_t *e)
-{
-    for (int i = 0; i < e->n_variants; i++) {
-        c->total_bytes -= e->wire_lens[i];
-        free(e->wires[i]);
-        e->wires[i] = NULL;
-    }
-    e->n_variants = 0;
-    if (e->used) {
-        e->used = 0;
-        c->n_entries--;
-    }
-}
 
 static void
 fp_cache_free(fp_cache_t *c)
 {
-    if (c->slots != NULL) {
-        for (uint32_t i = 0; i <= c->mask; i++) {
-            if (c->slots[i].used)
-                fp_entry_free(c, &c->slots[i]);
-        }
-        free(c->slots);
-        c->slots = NULL;
-    }
+    fp_core_free(c);
     free(c);
 }
 
@@ -200,53 +118,6 @@ fp_load_buckets(PyObject *seq, double *out, int *n_out, const char *what)
     return 0;
 }
 
-static int
-fp_bucket_index(const double *buckets, int n, double v)
-{
-    /* first bucket with bound >= v; n == +Inf cell (matches Python's
-     * bisect_left non-cumulative cells in metrics/collector.py) */
-    int i = 0;
-    while (i < n && buckets[i] < v)
-        i++;
-    return i;
-}
-
-static fp_qstat_t *
-fp_qstat(fp_cache_t *c, uint16_t qtype)
-{
-    for (int i = 0; i < c->n_qstats; i++) {
-        if (c->qstats[i].qtype == qtype)
-            return &c->qstats[i];
-    }
-    if (c->n_qstats < FP_MAX_QTYPES - 1) {
-        fp_qstat_t *s = &c->qstats[c->n_qstats++];
-        memset(s, 0, sizeof(*s));
-        s->qtype = qtype;
-        return s;
-    }
-    /* overflow: the final slot is a dedicated catch-all labeled with the
-     * sentinel qtype (folded as "other" by the server) — a client
-     * cycling many qtypes must not misattribute counts to a real type */
-    fp_qstat_t *s = &c->qstats[FP_MAX_QTYPES - 1];
-    if (c->n_qstats < FP_MAX_QTYPES) {
-        memset(s, 0, sizeof(*s));
-        s->qtype = FP_QTYPE_OTHER;
-        c->n_qstats = FP_MAX_QTYPES;
-    }
-    return s;
-}
-
-/* ---------------- key construction / wire parsing ---------------- */
-
-/* key construction delegates to the shared builder (kept in lockstep
- * with the balancer cache and the Python pusher) */
-static size_t
-fp_build_key(const uint8_t *buf, size_t len, uint8_t *key,
-             size_t *qn_len_out, uint16_t *qtype_out)
-{
-    return dnskey_build(buf, len, key, qn_len_out, qtype_out);
-}
-
 /* Append (payload, addr) to the miss list in recv_batch's item format.
  * Returns 0 on success; -1 with a Python exception set. */
 static int
@@ -265,26 +136,6 @@ surface_miss(PyObject *misses, const uint8_t *pkt, size_t plen,
     int rc = PyList_Append(misses, item);
     Py_DECREF(item);
     return rc;
-}
-
-static fp_entry_t *
-fp_find(fp_cache_t *c, const uint8_t *key, size_t keylen, uint64_t gen,
-        double now)
-{
-    uint64_t h = fp_hash(key, keylen);
-    for (int p = 0; p < FP_PROBE; p++) {
-        fp_entry_t *e = &c->slots[(h + (uint64_t)p) & c->mask];
-        if (!e->used)
-            continue;
-        if (e->keylen != keylen || memcmp(e->key, key, keylen) != 0)
-            continue;
-        if (e->gen != gen || now > e->expire_at) {
-            fp_entry_free(c, e);        /* lazy invalidation */
-            return NULL;
-        }
-        return e;
-    }
-    return NULL;
 }
 
 /* ---------------- module functions ---------------- */
@@ -307,18 +158,10 @@ fastpath_new(PyObject *self, PyObject *args)
     fp_cache_t *c = calloc(1, sizeof(*c));
     if (c == NULL)
         return PyErr_NoMemory();
-    /* 2x capacity so the probe window rarely fills before `size`
-     * distinct keys are live */
-    uint64_t want = 64;
-    while (want < (uint64_t)size * 2 && want < (1u << 24))
-        want <<= 1;
-    c->slots = calloc(want, sizeof(fp_entry_t));
-    if (c->slots == NULL) {
+    if (fp_core_init(c, size, expiry_ms) < 0) {
         free(c);
         return PyErr_NoMemory();
     }
-    c->mask = (uint32_t)(want - 1);
-    c->expiry_s = (double)expiry_ms / 1000.0;
     if (fp_load_buckets(lat_buckets, c->lat_buckets,
                         &c->n_lat_buckets, "latency") < 0 ||
         fp_load_buckets(size_buckets, c->size_buckets,
@@ -353,10 +196,6 @@ fastpath_put(PyObject *self, PyObject *args)
         PyBuffer_Release(&keybuf);
         return NULL;
     }
-    if (keybuf.len < 8 || keybuf.len > FP_MAX_KEY) {
-        PyBuffer_Release(&keybuf);
-        Py_RETURN_FALSE;                /* not representable: skip */
-    }
     PyObject *fast = PySequence_Fast(wires, "wires must be a sequence");
     if (fast == NULL) {
         PyBuffer_Release(&keybuf);
@@ -368,8 +207,9 @@ fastpath_put(PyObject *self, PyObject *args)
         PyBuffer_Release(&keybuf);
         Py_RETURN_FALSE;
     }
-    /* validate + measure before touching the table */
-    uint64_t add_bytes = 0;
+    /* borrow the wire pointers (valid while `fast` is held) */
+    const uint8_t *wire_ptrs[FP_MAX_VARIANTS];
+    uint16_t wire_lens[FP_MAX_VARIANTS];
     for (Py_ssize_t i = 0; i < nw; i++) {
         char *data;
         Py_ssize_t dlen;
@@ -384,72 +224,21 @@ fastpath_put(PyObject *self, PyObject *args)
             PyBuffer_Release(&keybuf);
             Py_RETURN_FALSE;            /* oversize answers stay in Python */
         }
-        add_bytes += (uint64_t)dlen;
-    }
-    if (c->total_bytes + add_bytes > FP_MAX_TOTAL_BYTES) {
-        Py_DECREF(fast);
-        PyBuffer_Release(&keybuf);
-        Py_RETURN_FALSE;
+        wire_ptrs[i] = (const uint8_t *)data;
+        wire_lens[i] = (uint16_t)dlen;
     }
 
-    const uint8_t *key = keybuf.buf;
-    size_t keylen = (size_t)keybuf.len;
-    double now = fp_now();
-    uint64_t h = fp_hash(key, keylen);
-    fp_entry_t *target = NULL, *oldest = NULL;
-    for (int p = 0; p < FP_PROBE; p++) {
-        fp_entry_t *e = &c->slots[(h + (uint64_t)p) & c->mask];
-        if (e->used && e->keylen == keylen &&
-            memcmp(e->key, key, keylen) == 0) {
-            target = e;                 /* replace in place */
-            break;
-        }
-        if (!e->used) {
-            if (target == NULL)
-                target = e;
-            continue;
-        }
-        if (oldest == NULL || e->inserted_at < oldest->inserted_at)
-            oldest = e;
-    }
-    if (target == NULL)
-        target = oldest;                /* probe window full: evict oldest */
-    if (target->used)
-        fp_entry_free(c, target);
-
-    memcpy(target->key, key, keylen);
-    target->keylen = (uint16_t)keylen;
-    target->gen = (uint64_t)gen;
-    target->inserted_at = now;
-    /* the pusher may hand down the *remaining* lifetime so an entry
-     * completed late in its Python-cache life can't live ~2x expiry */
-    target->expire_at = now + (expiry_ms >= 0 ? (double)expiry_ms / 1000.0
-                                              : c->expiry_s);
-    target->next_variant = 0;
-    target->qtype = (uint16_t)qtype;
-    target->n_variants = 0;
-    for (Py_ssize_t i = 0; i < nw; i++) {
-        char *data;
-        Py_ssize_t dlen;
-        PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
-                                &data, &dlen);   /* validated above */
-        uint8_t *copy = malloc((size_t)dlen);
-        if (copy == NULL) {
-            fp_entry_free(c, target);
-            Py_DECREF(fast);
-            PyBuffer_Release(&keybuf);
-            return PyErr_NoMemory();
-        }
-        memcpy(copy, data, (size_t)dlen);
-        target->wires[i] = copy;
-        target->wire_lens[i] = (uint16_t)dlen;
-        target->n_variants = (uint8_t)(i + 1);
-        c->total_bytes += (uint64_t)dlen;
-    }
-    target->used = 1;
-    c->n_entries++;
+    double expiry_s = expiry_ms >= 0 ? (double)expiry_ms / 1000.0
+                                     : c->expiry_s;
+    int rc = fp_put_raw(c, keybuf.buf, (size_t)keybuf.len,
+                        (uint16_t)qtype, (uint64_t)gen, wire_ptrs,
+                        wire_lens, (int)nw, fp_now(), expiry_s);
     Py_DECREF(fast);
     PyBuffer_Release(&keybuf);
+    if (rc < 0)
+        return PyErr_NoMemory();
+    if (rc == 0)
+        Py_RETURN_FALSE;
     Py_RETURN_TRUE;
 }
 
@@ -514,16 +303,12 @@ fastpath_drain(PyObject *self, PyObject *args)
     for (int i = 0; i < n; i++) {
         const uint8_t *pkt = bufs[i];
         size_t plen = msgs[i].msg_len;
-        uint8_t key[FP_MAX_KEY];
-        size_t qn_len = 0;
-        uint16_t qtype = 0;
-        fp_entry_t *e = NULL;
+        uint16_t entry_qtype = 0;
+        uint8_t *out = outs[n_hits];
 
-        c->lookups++;
-        size_t keylen = fp_build_key(pkt, plen, key, &qn_len, &qtype);
-        if (keylen != 0)
-            e = fp_find(c, key, keylen, (uint64_t)gen, t0);
-        if (e == NULL) {
+        size_t wlen = fp_serve_one(c, pkt, plen, (uint64_t)gen, t0, out,
+                                   &entry_qtype);
+        if (wlen == 0) {
             /* miss: surface to Python exactly like recv_batch */
             if (surface_miss(misses, pkt, plen, &addrs[i]) < 0) {
                 Py_DECREF(misses);
@@ -531,28 +316,6 @@ fastpath_drain(PyObject *self, PyObject *args)
             }
             continue;
         }
-
-        /* hit: copy the variant, patch id + the client's question bytes
-         * (same length by construction — key match implies identical
-         * lowercased label structure) */
-        uint8_t v = e->next_variant;
-        e->next_variant = (uint8_t)((v + 1) % e->n_variants);
-        const uint8_t *wire = e->wires[v];
-        size_t wlen = e->wire_lens[v];
-        if (wlen < 12 + qn_len + 4) {
-            /* defensive: a cached response must embed the question */
-            fp_entry_free(c, e);
-            if (surface_miss(misses, pkt, plen, &addrs[i]) < 0) {
-                Py_DECREF(misses);
-                return NULL;
-            }
-            continue;
-        }
-        uint8_t *out = outs[n_hits];
-        memcpy(out, wire, wlen);
-        out[0] = pkt[0];
-        out[1] = pkt[1];
-        memcpy(out + 12, pkt + 12, qn_len + 4);
 
         oiovs[n_hits].iov_base = out;
         oiovs[n_hits].iov_len = wlen;
@@ -562,8 +325,7 @@ fastpath_drain(PyObject *self, PyObject *args)
         omsgs[n_hits].msg_hdr.msg_namelen = msgs[i].msg_hdr.msg_namelen;
         n_hits++;
 
-        c->hits++;
-        fp_qstat_t *qs = fp_qstat(c, e->qtype);
+        fp_qstat_t *qs = fp_qstat(c, entry_qtype);
         qs->size_sum += (double)wlen;
         qs->size_cells[fp_bucket_index(c->size_buckets,
                                        c->n_size_buckets,
@@ -682,9 +444,6 @@ fastpath_clear(PyObject *self, PyObject *args)
     fp_cache_t *c = fp_from_capsule(capsule);
     if (c == NULL)
         return NULL;
-    for (uint32_t i = 0; i <= c->mask; i++) {
-        if (c->slots[i].used)
-            fp_entry_free(c, &c->slots[i]);
-    }
+    fp_core_clear(c);
     Py_RETURN_NONE;
 }

@@ -6,7 +6,6 @@ serve pre-mutation data.
 """
 import asyncio
 
-import pytest
 
 from binder_tpu.dns import Message, Rcode, Type, make_query
 from binder_tpu.metrics.collector import MetricsCollector

@@ -9,7 +9,6 @@ import asyncio
 import json
 import os
 import struct
-import subprocess
 
 import pytest
 

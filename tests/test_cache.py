@@ -4,9 +4,7 @@ Covers the reference's watch-tree semantics (lib/zk.js) plus the churn /
 session-reset hazards SURVEY §7.3 calls out — none of which the reference
 itself tests (it has no fake store, SURVEY §4).
 """
-import json
 
-import pytest
 
 from binder_tpu.store import FakeStore, MirrorCache, domain_to_path
 

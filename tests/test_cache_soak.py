@@ -17,7 +17,7 @@ bidirectional consistency:
 import json
 import random
 
-from binder_tpu.store import FakeStore, MirrorCache, domain_to_path
+from binder_tpu.store import FakeStore, MirrorCache
 
 DOMAIN = "foo.com"
 ROOT = "/com/foo"

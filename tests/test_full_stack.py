@@ -17,7 +17,7 @@ import os
 
 import pytest
 
-from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.dns import Rcode, Type
 from binder_tpu.metrics.collector import MetricsCollector
 from binder_tpu.server import BinderServer
 from binder_tpu.store import MirrorCache

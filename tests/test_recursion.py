@@ -7,7 +7,6 @@ matrix it leaves untested.
 """
 import asyncio
 
-import pytest
 
 from binder_tpu.dns import Message, Rcode, Type, make_query
 from binder_tpu.metrics.collector import MetricsCollector

@@ -10,7 +10,6 @@ import asyncio
 import socket
 import struct
 
-import pytest
 
 from binder_tpu.dns import Message, Rcode, Type, make_query
 from binder_tpu.dns.server import pack_balancer_frame, unpack_balancer_frame

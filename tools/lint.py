@@ -1,0 +1,259 @@
+#!/usr/bin/env python3
+"""First-party Python lint gate (the jsstyle/javascriptlint analog).
+
+The reference gates CI on vendored linters (`make check` runs jsstyle +
+javascriptlint, reference Jenkinsfile:37-40, deps/jsstyle,
+deps/javascriptlint); this image ships no Python linter, so this tool
+implements the high-signal, zero-false-positive subset used by `make
+check`.  Zero findings is the passing state; every rule here is cheap to
+satisfy and each finding is a real smell:
+
+  unused-import        imported name never referenced in the module
+  import-shadowed      def/class rebinds an imported name
+  bare-except          `except:` catches SystemExit/KeyboardInterrupt
+  duplicate-dict-key   constant key repeated in a dict literal
+  f-string-no-placeholder  f-prefix on a string with no {…}
+  is-literal           `is` / `is not` against a str/number literal
+  mutable-default      def f(x=[]) / f(x={}) / f(x=set())
+  assert-tuple         assert (cond, "msg") — always true
+
+Usage: python tools/lint.py <paths...>   (directories are walked for .py
+files; explicit files are linted regardless of extension so bin/ scripts
+can be covered).
+"""
+import ast
+import os
+import sys
+
+
+class Finding:
+    def __init__(self, path, line, rule, msg):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.msg = msg
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def iter_strings(node):
+    """All string constants syntactically inside `node` (docstrings and
+    __all__ entries count as usage for re-export barrels)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+class Linter(ast.NodeVisitor):
+    def __init__(self, path, tree, source):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.findings = []
+
+    def add(self, node, rule, msg):
+        self.findings.append(Finding(self.path, node.lineno, rule, msg))
+
+    def run(self):
+        self.check_imports()
+        self.visit(self.tree)
+        return self.findings
+
+    # ---- unused imports / shadowing (module scope) ----
+
+    def check_imports(self):
+        # __init__.py imports are re-export surface (the lib/index.js
+        # barrel pattern); "unused" is their whole point
+        barrel = os.path.basename(self.path) == "__init__.py"
+        imported = {}   # name -> (node, reported_name)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    imported.setdefault(name, (node, a.asname or a.name))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    imported.setdefault(name, (node, name))
+
+        used = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                # handled via the Name at the base of the chain
+                pass
+        # names mentioned in strings count (docstring references, __all__,
+        # typing forward refs)
+        strings = set()
+        for s in iter_strings(self.tree):
+            if len(s) < 200:
+                for tok in s.replace(",", " ").replace("'", " ").split():
+                    strings.add(tok.strip("\"`()[]{}.:;"))
+
+        redefined = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                if node.name in imported:
+                    redefined.add(node.name)
+                    self.add(node, "import-shadowed",
+                             f"definition of {node.name!r} shadows an "
+                             f"import of the same name")
+
+        if barrel:
+            return
+        for name, (node, reported) in imported.items():
+            if name.startswith("_") or name in redefined:
+                continue
+            if name not in used and name not in strings:
+                self.add(node, "unused-import",
+                         f"{reported!r} imported but unused")
+
+    # ---- node-local rules ----
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node, "bare-except",
+                     "bare `except:` also catches SystemExit/"
+                     "KeyboardInterrupt; use `except Exception:`")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        seen = {}
+        for k in node.keys:
+            if isinstance(k, ast.Constant):
+                try:
+                    hash(k.value)
+                except TypeError:
+                    continue
+                if k.value in seen:
+                    self.add(k, "duplicate-dict-key",
+                             f"duplicate dict key {k.value!r}")
+                seen[k.value] = True
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node, "f-string-no-placeholder",
+                     "f-string has no placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node):
+        # format specs (f"{x:>3}") are themselves JoinedStr nodes holding
+        # only Constants; don't descend or every spec is a false positive
+        self.visit(node.value)
+
+    def visit_Compare(self, node):
+        # chained comparisons: op[i] compares comparators[i-1] (or .left
+        # for i == 0) with comparators[i]
+        lefts = [node.left] + list(node.comparators[:-1])
+        for left, op, comp in zip(lefts, node.ops, node.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)):
+                operands = [comp, left]
+                for o in operands:
+                    if isinstance(o, ast.Constant) and isinstance(
+                            o.value, (str, int, float, bytes)) and \
+                            not isinstance(o.value, bool):
+                        self.add(node, "is-literal",
+                                 "`is` comparison with a literal; "
+                                 "use == / !=")
+                        break
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set")
+                    and not d.args and not d.keywords):
+                self.add(d, "mutable-default",
+                         "mutable default argument; use None and "
+                         "initialize inside")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.add(node, "assert-tuple",
+                     "assert on a non-empty tuple is always true "
+                     "(did you mean `assert cond, msg`?)")
+        self.generic_visit(node)
+
+
+def lint_file(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(path, 0, "unreadable", str(e))]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "syntax-error", e.msg)]
+    return Linter(path, tree, source).run()
+
+
+def is_python_script(path):
+    if path.endswith(".py"):
+        return True
+    try:
+        with open(path, "rb") as f:
+            head = f.read(64)
+        return head.startswith(b"#!") and b"python" in head.splitlines()[0]
+    except OSError:
+        return False
+
+
+def collect(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for fn in sorted(files):
+                    full = os.path.join(root, fn)
+                    if is_python_script(full):
+                        out.append(full)
+        else:
+            if is_python_script(p):
+                out.append(p)
+    return out
+
+
+def main(argv):
+    paths = argv or ["binder_tpu", "tests", "bin", "tools",
+                     "bench.py", "bench_impl.py", "__graft_entry__.py"]
+    files = collect(paths)
+    if not files:
+        print("lint: no files found", file=sys.stderr)
+        return 2
+    findings = []
+    for path in files:
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint: {len(findings)} finding(s) in {len(files)} files",
+              file=sys.stderr)
+        return 1
+    print(f"lint: ok ({len(files)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
