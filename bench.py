@@ -35,7 +35,10 @@ def main():
             "error": f"{type(e).__name__}: {e}",
         }
     print(json.dumps(result))
+    # a broken bench must fail the `make ci` bench-smoke gate, not just
+    # report an error field (the driver reads the JSON either way)
+    return 1 if "error" in result else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

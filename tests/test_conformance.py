@@ -1,0 +1,382 @@
+"""Independent-implementation conformance tests (VERDICT r2 missing 2).
+
+Every other protocol test uses this repo's codec on both ends, so a
+symmetric encode/decode bug could pass the whole suite.  The reference
+broke that symmetry by scraping real dig(1) against a real ZooKeeper
+(reference test/dig.js:109-134, test/helper.js:53-61).  This module does
+it four ways, each independent of our codec to a different degree:
+
+1. **RFC golden byte-vectors** (always run): wire bytes hand-assembled
+   from RFC 1035/2782/6891 — encode must produce them exactly, decode
+   must read them exactly, including a compression-pointer answer our
+   encoder would lay out differently.
+2. **dig(1)** against a live server (skipped when dig is absent —
+   this image ships none; lights up wherever bind-utils exists).
+3. **glibc stub resolver** (`getent hosts`) against a live server on
+   127.0.0.1:53 — opt-in via BINDER_LIBC_CONFORMANCE=1 because it
+   rewrites /etc/resolv.conf (restored afterwards) and binds port 53.
+4. **Real ZooKeeper** for the store client when ZK_HOST is set (the
+   reference's own test precondition, README.md:63-65).
+"""
+import asyncio
+import ipaddress
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from binder_tpu.dns import (
+    ARecord,
+    Message,
+    OPTRecord,
+    PTRRecord,
+    Rcode,
+    SOARecord,
+    SRVRecord,
+    Type,
+    make_query,
+)
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+DOMAIN = "foo.com"
+
+
+# ---------------------------------------------------------------------------
+# 1. RFC golden byte-vectors
+
+
+class TestGoldenVectors:
+    """Wire bytes written by hand from the RFCs, never produced by the
+    code under test."""
+
+    # RFC 1035 §4.1: standard query, id 0x1234, RD, QDCOUNT 1,
+    # QNAME example.com, QTYPE A, QCLASS IN
+    QUERY_A = bytes.fromhex(
+        "1234"              # id
+        "0100"              # flags: RD
+        "0001" "0000" "0000" "0000"
+        "07" "6578616d706c65" "03" "636f6d" "00"   # 7example3com0
+        "0001" "0001"       # A IN
+    )
+
+    def test_query_encode_matches_rfc_bytes(self):
+        got = make_query("example.com", Type.A, qid=0x1234, rd=True,
+                         edns_payload=None).encode()
+        assert got == self.QUERY_A
+
+    def test_query_decode_matches_rfc_fields(self):
+        m = Message.decode(self.QUERY_A)
+        assert m.id == 0x1234
+        assert m.qr is False and m.rd is True and m.opcode == 0
+        assert len(m.questions) == 1
+        q = m.questions[0]
+        assert (q.name, q.qtype, q.qclass) == ("example.com", 1, 1)
+        assert not m.answers and not m.authorities and not m.additionals
+
+    def test_mixed_case_query_normalizes_on_encode(self):
+        # RFC 1035 §2.3.3 case-insensitivity: our encoder lowercases
+        assert make_query("ExAmPlE.CoM", Type.A, qid=0x1234, rd=True,
+                          edns_payload=None).encode() == self.QUERY_A
+
+    # RFC 1035 §4.1.4 compression: response whose answer name is a
+    # pointer to offset 12 (0xC00C) — our encoder also compresses, but
+    # decode here is driven purely by the hand bytes
+    RESPONSE_A = bytes.fromhex(
+        "1234"              # id
+        "8580"              # QR AA RD RA, rcode 0
+        "0001" "0001" "0000" "0000"
+        "07" "6578616d706c65" "03" "636f6d" "00" "0001" "0001"
+        "c00c"              # answer name = pointer to QNAME
+        "0001" "0001"       # A IN
+        "0000012c"          # TTL 300
+        "0004" "5db8d822"   # rdlen 4, 93.184.216.34
+    )
+
+    def test_response_decode_with_compression_pointer(self):
+        m = Message.decode(self.RESPONSE_A)
+        assert m.qr is True and m.aa is True and m.ra is True
+        assert m.rcode == Rcode.NOERROR
+        (a,) = m.answers
+        assert isinstance(a, ARecord)
+        assert a.name == "example.com"
+        assert a.ttl == 300
+        assert a.address == "93.184.216.34"
+
+    def test_response_reencode_roundtrip(self):
+        # not byte-identical (compression layout is the encoder's), but
+        # a second decode must reproduce identical structures
+        m = Message.decode(self.RESPONSE_A)
+        again = Message.decode(m.encode())
+        assert again.answers == m.answers
+        assert again.questions == m.questions
+        assert (again.id, again.rcode, again.aa) == (m.id, m.rcode, m.aa)
+
+    # RFC 2782 SRV: _pg._tcp.svc.foo.com SRV 10 20 5432 lb0.svc.foo.com
+    # — target written UNcompressed per the RFC's erratum guidance
+    RESPONSE_SRV = bytes.fromhex(
+        "0007" "8400"
+        "0001" "0001" "0000" "0000"
+        "035f7067" "045f746370" "03737663" "03666f6f" "03636f6d" "00"
+        "0021" "0001"                       # SRV IN
+        "c00c"                              # answer name -> question
+        "0021" "0001" "0000001e"            # SRV IN TTL 30
+        "0017"                              # rdlen 23
+        "000a" "0014" "1538"                # prio 10 weight 20 port 5432
+        "036c6230" "03737663" "03666f6f" "03636f6d" "00"
+    )
+
+    def test_srv_decode_rfc2782(self):
+        m = Message.decode(self.RESPONSE_SRV)
+        (srv,) = m.answers
+        assert isinstance(srv, SRVRecord)
+        assert srv.name == "_pg._tcp.svc.foo.com"
+        assert (srv.priority, srv.weight, srv.port) == (10, 20, 5432)
+        assert srv.target == "lb0.svc.foo.com"
+        assert srv.ttl == 30
+
+    def test_srv_encode_target_uncompressed(self):
+        # RFC 2782: the target must not be compressed even when the
+        # suffix already appeared; assert on raw bytes
+        m = Message(id=7, qr=True, aa=True)
+        m.questions = list(Message.decode(self.RESPONSE_SRV).questions)
+        m.answers = [SRVRecord(name="_pg._tcp.svc.foo.com", ttl=30,
+                               priority=10, weight=20, port=5432,
+                               target="lb0.svc.foo.com")]
+        wire = m.encode()
+        assert bytes.fromhex("036c62300373766303666f6f03636f6d00") in wire
+
+    # RFC 1035 §3.3.12/§3.5: PTR response for 10.1.2.3
+    RESPONSE_PTR = bytes.fromhex(
+        "0009" "8400"
+        "0001" "0001" "0000" "0000"
+        "0133" "0132" "0131" "023130"       # 3.2.1.10
+        "07696e2d61646472" "046172706100"   # in-addr.arpa
+        "000c" "0001"
+        "c00c" "000c" "0001" "0000001e"
+        "000d"                              # rdlen 13
+        "0377656203666f6f03636f6d00"        # web.foo.com
+    )
+
+    def test_ptr_decode(self):
+        m = Message.decode(self.RESPONSE_PTR)
+        (ptr,) = m.answers
+        assert isinstance(ptr, PTRRecord)
+        assert ptr.name == "3.2.1.10.in-addr.arpa"
+        assert ptr.target == "web.foo.com"
+
+    # RFC 1035 §3.3.13 SOA (as the reference serves for NODATA/negative
+    # answers) — rdata with two names then five 32-bit fields
+    RESPONSE_SOA = bytes.fromhex(
+        "000b" "8400"
+        "0001" "0000" "0001" "0000"
+        "03666f6f03636f6d00" "0001" "0001"
+        "c00c" "0006" "0001" "00000e10"
+        "0029"                              # rdlen 41
+        "026e7303666f6f03636f6d00"          # mname ns.foo.com (12)
+        "07616461646d696e00"                # rname adadmin. (9)
+        "78512ec6" "00000e10" "00000384" "00093a80" "0000003c"
+    )
+
+    def test_soa_decode(self):
+        m = Message.decode(self.RESPONSE_SOA)
+        (soa,) = m.authorities
+        assert isinstance(soa, SOARecord)
+        assert soa.mname == "ns.foo.com"
+        assert soa.rname == "adadmin"
+        assert soa.serial == 0x78512EC6
+        assert (soa.refresh, soa.retry) == (3600, 900)
+        assert (soa.expire, soa.minimum) == (604800, 60)
+
+    # RFC 6891 EDNS0 OPT: root name, type 41, class = payload 1232,
+    # ttl = ext-rcode/version/flags zero, rdlen 0
+    QUERY_EDNS = bytes.fromhex(
+        "0042" "0000"
+        "0001" "0000" "0000" "0001"
+        "0377656203666f6f03636f6d00" "0001" "0001"
+        "00" "0029" "04d0" "00000000" "0000"
+    )
+
+    def test_edns_query_encode(self):
+        got = make_query("web.foo.com", Type.A, qid=0x42,
+                         edns_payload=1232).encode()
+        assert got == self.QUERY_EDNS
+
+    def test_edns_query_decode(self):
+        m = Message.decode(self.QUERY_EDNS)
+        (opt,) = m.additionals
+        assert isinstance(opt, OPTRecord)
+        assert opt.udp_payload_size == 1232
+        assert opt.version == 0 and not opt.dnssec_ok
+        assert not opt.has_options
+
+
+# ---------------------------------------------------------------------------
+# live-server fixtures shared by the dig and libc tiers
+
+
+def fixture_store():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "10.7.7.7"}})
+    store.put_json("/com/foo/svc", {
+        "type": "service",
+        "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432},
+    })
+    store.put_json("/com/foo/svc/lb0",
+                   {"type": "load_balancer",
+                    "load_balancer": {"address": "10.0.1.1"}})
+    store.start_session()
+    return store, cache
+
+
+async def serve(coro_fn, *, port=0, host="127.0.0.1"):
+    """Boot a BinderServer on the fake store and run coro_fn(server)."""
+    _, cache = fixture_store()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="coal", host=host, port=port,
+                          collector=MetricsCollector())
+    await server.start()
+    try:
+        return await coro_fn(server)
+    finally:
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2. dig(1) — the reference's own conformance client
+
+
+DIG = shutil.which("dig")
+
+
+@pytest.mark.skipif(DIG is None, reason="dig(1) not installed")
+class TestDigConformance:
+    def test_exchanges(self):
+        async def run(server):
+            port = server.udp_port
+            loop = asyncio.get_running_loop()
+
+            def digq(*args):
+                return subprocess.run(
+                    [DIG, "@127.0.0.1", "-p", str(port), "+time=3",
+                     "+tries=1", *args],
+                    capture_output=True, text=True, timeout=15).stdout
+
+            out = await loop.run_in_executor(None, digq, "web.foo.com", "A")
+            assert "status: NOERROR" in out and "10.7.7.7" in out
+            out = await loop.run_in_executor(
+                None, digq, "_pg._tcp.svc.foo.com", "SRV")
+            assert "status: NOERROR" in out and "5432" in out \
+                and "lb0.svc.foo.com" in out
+            out = await loop.run_in_executor(None, digq, "-x", "10.7.7.7")
+            assert "web.foo.com" in out
+            out = await loop.run_in_executor(
+                None, digq, "other.example", "A")
+            assert "status: REFUSED" in out
+            out = await loop.run_in_executor(
+                None, digq, "+tcp", "web.foo.com", "A")
+            assert "status: NOERROR" in out and "10.7.7.7" in out
+
+        asyncio.run(serve(run))
+
+
+# ---------------------------------------------------------------------------
+# 3. glibc stub resolver (getent) — opt-in, rewrites /etc/resolv.conf
+
+
+LIBC_GATE = os.environ.get("BINDER_LIBC_CONFORMANCE") == "1" \
+    and os.geteuid() == 0
+
+
+@pytest.mark.skipif(
+    not LIBC_GATE,
+    reason="set BINDER_LIBC_CONFORMANCE=1 (requires root; rewrites "
+           "/etc/resolv.conf and binds 127.0.0.1:53)")
+class TestLibcConformance:
+    def test_getent_a_and_ptr(self):
+        resolv = "/etc/resolv.conf"
+        saved = open(resolv).read()
+
+        async def run(server):
+            loop = asyncio.get_running_loop()
+            with open(resolv, "w") as f:
+                f.write("nameserver 127.0.0.1\noptions timeout:2 "
+                        "attempts:1\n")
+
+            def getent(*args):
+                return subprocess.run(["getent", *args],
+                                      capture_output=True, text=True,
+                                      timeout=15)
+
+            # forward A through gethostbyname/getaddrinfo
+            out = await loop.run_in_executor(
+                None, getent, "ahostsv4", "web.foo.com")
+            assert "10.7.7.7" in out.stdout, out
+            # reverse PTR through gethostbyaddr
+            out = await loop.run_in_executor(
+                None, getent, "hosts", "10.7.7.7")
+            assert "web.foo.com" in out.stdout, out
+
+        try:
+            asyncio.run(serve(run, port=53))
+        finally:
+            with open(resolv, "w") as f:
+                f.write(saved)
+
+
+# ---------------------------------------------------------------------------
+# 4. real ZooKeeper for the store client
+
+
+ZK_HOST = os.environ.get("ZK_HOST")
+
+
+@pytest.mark.skipif(ZK_HOST is None,
+                    reason="set ZK_HOST to run against a real ZooKeeper "
+                           "(the reference's test precondition, "
+                           "README.md:63-65)")
+class TestRealZooKeeper:
+    def test_session_reads_writes_watches(self):
+        from binder_tpu.store.zk_client import ZKClient
+
+        async def run():
+            port = int(os.environ.get("ZK_PORT", "2181"))
+            client = ZKClient(address=ZK_HOST, port=port,
+                              session_timeout_ms=10000)
+            client.start()
+            deadline = asyncio.get_running_loop().time() + 10
+            while not client.is_connected():
+                assert asyncio.get_running_loop().time() < deadline, \
+                    f"no ZK session to {ZK_HOST}:{port}"
+                await asyncio.sleep(0.05)
+
+            base = "/binder-conformance"
+            await client.mkdirp(base + "/web", b'{"type":"host"}')
+            assert await client.get_data(base + "/web") == \
+                b'{"type":"host"}'
+            kids = await client.get_children(base)
+            assert "web" in kids
+
+            # a watched read must see a real server's notification
+            ev = asyncio.Event()
+            w = client.watcher(base)
+            w.on("children", lambda kids: ev.set())
+            await client.create(base + "/second", b"x")
+            await asyncio.wait_for(ev.wait(), 10)
+
+            await client.delete(base + "/second")
+            await client.delete(base + "/web")
+            await client.delete(base)
+            client.close()
+
+        asyncio.run(run())
+
+
+def test_ip_vectors_sanity():
+    # guard the golden hex: the A rdata above really is 93.184.216.34
+    assert ipaddress.ip_address(bytes.fromhex("5db8d822")).exploded == \
+        "93.184.216.34"

@@ -97,7 +97,9 @@ class Linter(ast.NodeVisitor):
                     strings.add(tok.strip("\"`()[]{}.:;"))
 
         redefined = set()
-        for node in ast.walk(self.tree):
+        # module-level defs only: a method or nested function named like
+        # an import does not rebind the module-level name
+        for node in self.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 if node.name in imported:
