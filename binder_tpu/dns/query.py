@@ -32,7 +32,7 @@ class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
                  "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
-                 "cached_summary", "no_store")
+                 "cached_summary", "no_store", "dep_domain")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -57,6 +57,11 @@ class QueryCtx:
         # balancer-socket transport propagates it as the do-not-store
         # marker, docs/balancer-protocol.md)
         self.no_store = False
+        # set by the resolver at its store-lookup points: the mirrored
+        # name this query's answer derives from (service node domain for
+        # SRV, reverse qname for PTR) — the answer cache's per-name
+        # invalidation tag
+        self.dep_domain: Optional[str] = None
         self._responded = False
         self.bytes_sent = 0
         self.start = time.monotonic()

@@ -1,4 +1,4 @@
-"""Encoded-answer cache with store-generation invalidation.
+"""Encoded-answer cache with per-name (tag) invalidation.
 
 The modern incarnation of the reference's legacy cache flags (``-s size``
 default 10000, ``-a expiry`` default 60000 ms — reference
@@ -6,13 +6,18 @@ default 10000, ``-a expiry`` default 60000 ms — reference
 of names continuously, so the fully-encoded response bytes are cached, keyed
 on the decoded fields the response depends on (transport semantics,
 RD, question, EDNS presence/payload — see ``BinderServer._on_query``;
-raw-wire keying would let per-packet EDNS options mint unbounded keys).  Stored values are opaque
-to this class — the server stores ``(wire, answers_summary,
-additional_summary)`` tuples so cache hits keep full query-log detail.
+raw-wire keying would let per-packet EDNS options mint unbounded keys).
+Stored values are opaque to this class — the server stores ``(wire,
+answers_summary, additional_summary)`` tuples so cache hits keep full
+query-log detail.
 
 Correctness properties:
-- every entry records the mirror cache's generation counter; any mirrored
-  store mutation bumps it, so a hit can never serve pre-mutation data;
+- every entry records the mirror cache's *epoch* (bumped on full
+  rebuilds/session events), so a hit can never survive a re-mirror;
+- every entry carries a *dependency tag* — the store lookup domain (or
+  PTR qname) its answer derives from; a mirrored mutation invalidates
+  exactly the tags it touched (``MirrorCache.invalidate``), so one
+  churning record no longer evicts every cached answer;
 - round-robin is preserved: each miss stores another shuffle variant (up
   to ``variants_cap``), and hits cycle through the collected variants;
 - entries expire after ``expiry_ms`` regardless (defense in depth);
@@ -22,32 +27,45 @@ Correctness properties:
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 
 class AnswerCache:
     __slots__ = ("size", "expiry_s", "variants_cap", "_entries",
-                 "hits", "misses")
+                 "_by_tag", "hits", "misses", "invalidations")
 
     def __init__(self, size: int = 10000, expiry_ms: int = 60000,
                  variants_cap: int = 8) -> None:
         self.size = size
         self.expiry_s = expiry_ms / 1000.0
         self.variants_cap = variants_cap
-        # key -> [gen, created, next_variant_idx, [value, ...], complete]
+        # key -> [epoch, created, next_variant_idx, [value, ...],
+        #         complete, tag]
         self._entries: Dict[object, list] = {}
+        # dependency tag -> keys whose answers derive from it
+        self._by_tag: Dict[str, Set[object]] = {}
         self.hits = 0
         self.misses = 0
+        self.invalidations = 0
 
-    def get(self, key, gen: int) -> Optional[object]:
+    def _drop(self, key, e) -> None:
+        del self._entries[key]
+        tag = e[5]
+        keys = self._by_tag.get(tag)
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_tag[tag]
+
+    def get(self, key, epoch: int) -> Optional[object]:
         if self.size <= 0:
             return None
         e = self._entries.get(key)
         if e is None:
             self.misses += 1
             return None
-        if e[0] != gen or time.monotonic() - e[1] > self.expiry_s:
-            del self._entries[key]
+        if e[0] != epoch or time.monotonic() - e[1] > self.expiry_s:
+            self._drop(key, e)
             self.misses += 1
             return None
         variants = e[3]
@@ -61,43 +79,63 @@ class AnswerCache:
         self.hits += 1
         return variants[idx]
 
-    def put(self, key, gen: int, value: object,
-            rotatable: bool = False) -> bool:
-        """Record a freshly resolved value.  Returns True exactly when the
-        entry just became *complete* (non-rotatable, or the full variant
-        set collected) — the signal the server uses to push the entry to
-        the native fast path (see BinderServer._on_query)."""
+    def put(self, key, epoch: int, value: object,
+            rotatable: bool = False, tag: Optional[str] = None) -> bool:
+        """Record a freshly resolved value.  ``tag`` is the store name
+        the answer depends on (defaults handled by the caller).  Returns
+        True exactly when the entry just became *complete*
+        (non-rotatable, or the full variant set collected) — the signal
+        the server uses to push the entry to the native fast path (see
+        BinderServer._on_query)."""
         if self.size <= 0:
             return False
         e = self._entries.get(key)
-        if e is not None and e[0] == gen:
+        if e is not None and e[0] == epoch:
             if len(e[3]) < self.variants_cap:
                 e[3].append(value)
                 return not e[4] and len(e[3]) == self.variants_cap
             return False
+        if e is not None:
+            self._drop(key, e)          # stale epoch: replace cleanly
         if len(self._entries) >= self.size:
             # evict oldest insertion (dicts preserve insertion order)
-            self._entries.pop(next(iter(self._entries)))
-        self._entries[key] = [gen, time.monotonic(), 0, [value],
-                              not rotatable]
+            old_key = next(iter(self._entries))
+            self._drop(old_key, self._entries[old_key])
+        self._entries[key] = [epoch, time.monotonic(), 0, [value],
+                              not rotatable, tag]
+        self._by_tag.setdefault(tag, set()).add(key)
         return not rotatable
 
-    def variants(self, key, gen: int) -> Optional[List[object]]:
+    def invalidate_tag(self, tag: str) -> int:
+        """Drop every entry whose answer derives from ``tag``; returns
+        how many were dropped."""
+        keys = self._by_tag.pop(tag, None)
+        if not keys:
+            return 0
+        n = 0
+        for key in keys:
+            if self._entries.pop(key, None) is not None:
+                n += 1
+        self.invalidations += n
+        return n
+
+    def variants(self, key, epoch: int) -> Optional[List[object]]:
         """All collected variants for a live entry (fast-path push)."""
         e = self._entries.get(key)
-        if e is None or e[0] != gen:
+        if e is None or e[0] != epoch:
             return None
         return list(e[3])
 
-    def remaining_ttl_ms(self, key, gen: int) -> Optional[float]:
+    def remaining_ttl_ms(self, key, epoch: int) -> Optional[float]:
         """Milliseconds until this entry's time expiry — a late-completed
         rotatable entry must carry its *remaining* lifetime into the
         native fast path, not a fresh full window."""
         e = self._entries.get(key)
-        if e is None or e[0] != gen:
+        if e is None or e[0] != epoch:
             return None
         return max(0.0, (self.expiry_s - (time.monotonic() - e[1]))
                    * 1000.0)
 
     def clear(self) -> None:
         self._entries.clear()
+        self._by_tag.clear()

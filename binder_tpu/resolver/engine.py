@@ -169,6 +169,11 @@ class Resolver:
             query.respond()
             return
 
+        # dependency tag for the answer caches: whatever this lookup
+        # yields (including a miss-REFUSED) changes when `domain`
+        # mutates in the store — note for SRV this is the *service node*
+        # domain, not the _svc._proto-prefixed qname
+        query.dep_domain = domain
         node = self.cache.lookup(domain)
 
         if node is None:
@@ -301,6 +306,9 @@ class Resolver:
 
         query.log_ctx["query"] = {"ip": ip, "type": query.qtype_name()}
 
+        # dependency tag: mutations touching this address emit the
+        # normalized reverse qname (store/cache.py _rev_name)
+        query.dep_domain = domain.lower()
         node = self.cache.reverse_lookup(ip)
         if node is None:
             if self.recursion is not None and query.rd():

@@ -30,6 +30,8 @@ from binder_tpu.dns.wire import (
     Rcode,
     SRVRecord,
     Type,
+    WireError,
+    encode_name,
 )
 from binder_tpu.metrics.collector import (
     DEFAULT_SIZE_BUCKETS,
@@ -125,6 +127,10 @@ class BinderServer:
         self.cache_hit_counter = self.collector.counter(
             "binder_answer_cache_hits", "encoded-answer cache hits")
         self._cache_hit_child = self.cache_hit_counter.labelled()
+        self.collector.gauge(
+            "binder_answer_cache_invalidations",
+            "answer-cache entries dropped by per-name store invalidation"
+        ).set_function(lambda: float(self.answer_cache.invalidations))
 
         self.request_counter = self.collector.counter(
             METRIC_REQUEST_COUNTER, "count of Binder requests completed")
@@ -180,6 +186,13 @@ class BinderServer:
         self.engine.gen_source = lambda: self.zk_cache.gen
         if hasattr(zk_cache, "on_mutation"):
             zk_cache.on_mutation(self.engine.notify_mutation)
+        # Per-name invalidation: a mirrored mutation drops exactly the
+        # answer-cache/fast-path entries whose dependency tag it touched
+        # (MirrorCache.invalidate); the epoch (bumped on full rebuilds)
+        # covers everything else.  One churning record no longer evicts
+        # every cached answer.
+        if hasattr(zk_cache, "on_invalidate"):
+            zk_cache.on_invalidate(self._on_store_invalidate)
 
         self._fastpath = None
         self._fp_folded: dict = {}
@@ -191,7 +204,7 @@ class BinderServer:
                 [float(b) for b in self.latency_histogram.buckets],
                 [float(b) for b in self.size_histogram.buckets])
             self.engine.fastpath = self._fastpath
-            self.engine.fastpath_gen = lambda: self.zk_cache.gen
+            self.engine.fastpath_gen = lambda: self.zk_cache.epoch
             self.engine.fastpath_gate = self._fastpath_active
             self.collector.on_expose(self._fold_fastpath_metrics)
 
@@ -222,7 +235,7 @@ class BinderServer:
             q0 = req.questions[0]
             key = (query.udp_semantics, req.rd, q0.qtype, q0.qclass,
                    q0.name, req.edns is not None, req.max_udp_payload())
-            cached = self.answer_cache.get(key, self.zk_cache.gen)
+            cached = self.answer_cache.get(key, self.zk_cache.epoch)
             if cached is not None:
                 wire, ans, add = cached
                 self._cache_hit_child.inc()
@@ -243,20 +256,54 @@ class BinderServer:
             # reused by _on_after for this query's own log line too —
             # summaries are built exactly once per resolve
             query.cached_summary = (ans, add)
-            gen = self.zk_cache.gen
+            epoch = self.zk_cache.epoch
+            # dependency tag: the store name this answer derives from
+            # (set by the resolver at its lookup points); immutable
+            # shapes (out-of-suffix REFUSED, NOTIMP) never consulted the
+            # store, but tagging them with their own qname is harmless —
+            # no mutation will ever emit it
+            tag = query.dep_domain or q0.name
             completed = self.answer_cache.put(
-                key, gen, (query.wire, ans, add),
-                rotatable=len(query.response.answers) > 1)
+                key, epoch, (query.wire, ans, add),
+                rotatable=len(query.response.answers) > 1, tag=tag)
             # push only while the C path can actually drain — with the
             # gate closed (query_log on / probes attached) the native
             # cache would just accumulate dead wires; after a runtime
             # toggle it repopulates from misses within one expiry window
             if (completed and self._fastpath is not None
                     and query.udp_semantics and self._fastpath_active()):
-                self._fastpath_push(key, gen, query)
+                self._fastpath_push(key, epoch, query, tag)
         return pending
 
-    def _fastpath_push(self, key, gen: int, query: QueryCtx) -> None:
+    @staticmethod
+    def _qname_wire(name: str) -> Optional[bytes]:
+        """Lowercased wire label form of a dotted name — the dependency
+        tag format shared with the C caches (fpcore.h fp_invalidate_tag).
+        Delegates to the one real name encoder (wire.encode_name, which
+        normalizes case and enforces label/name bounds); None for names
+        that cannot appear as a C-side tag."""
+        buf = bytearray()
+        try:
+            encode_name(name, buf, None)
+        except (WireError, UnicodeEncodeError):
+            return None
+        return bytes(buf)
+
+    def _on_store_invalidate(self, tags) -> None:
+        """MirrorCache invalidation subscriber: drop the cached answers
+        whose dependency tag a store mutation touched."""
+        for tag in tags:
+            self.answer_cache.invalidate_tag(tag)
+            if self._fastpath is not None:
+                wire = self._qname_wire(tag)
+                if wire is not None:
+                    try:
+                        _fastio.fastpath_invalidate(self._fastpath, wire)
+                    except (TypeError, ValueError):
+                        pass
+
+    def _fastpath_push(self, key, epoch: int, query: QueryCtx,
+                       tag: str) -> None:
         """Hand a just-completed answer-cache entry to the native fast
         path.  The C key is built from the request's raw qname bytes so
         both key builders see identical input; names outside the
@@ -265,15 +312,19 @@ class BinderServer:
         ckey = self._fastpath_key(query)
         if ckey is None:
             return
-        variants = self.answer_cache.variants(key, gen)
+        tag_wire = self._qname_wire(tag)
+        if tag_wire is None:
+            return                      # not invalidatable: keep in Python
+        variants = self.answer_cache.variants(key, epoch)
         if not variants:
             return
         wires = [v[0] for v in variants]
-        ttl_ms = self.answer_cache.remaining_ttl_ms(key, gen)
+        ttl_ms = self.answer_cache.remaining_ttl_ms(key, epoch)
         try:
             _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
-                                 gen, wires,
-                                 -1 if ttl_ms is None else int(ttl_ms))
+                                 epoch, wires,
+                                 -1 if ttl_ms is None else int(ttl_ms),
+                                 tag_wire)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("fastpath push skipped: %s", e)
 
@@ -406,8 +457,8 @@ class BinderServer:
         # the key layout must stay byte-for-byte with _on_query's
         key = (udp_sem, bool(rd_flag), 1, 1, name, edns, payload)
         cache = self.zk_cache
-        gen = cache.gen
-        hit = self.answer_cache.get(key, gen)
+        epoch = cache.epoch
+        hit = self.answer_cache.get(key, epoch)
         if hit is not None:
             cached = hit[0]
             # patch in this requester's id AND question bytes: cached
@@ -502,17 +553,22 @@ class BinderServer:
                 q_low = q_sec.lower()
                 cache_wire = (wire if q_sec == q_low
                               else wire[:12] + q_low + wire[q_end:])
+                # lane answers (hit, miss-REFUSED, suffix-REFUSED) all
+                # depend on exactly this name; the qname doubles as the
+                # dependency tag
                 completed = self.answer_cache.put(
-                    key, gen, (cache_wire, ans, []), rotatable=False)
+                    key, epoch, (cache_wire, ans, []), rotatable=False,
+                    tag=name)
                 if (completed and self._fastpath is not None and udp_sem
                         and self._fastpath_active()):
+                    qname_low = data[12:q_end - 4].lower()
                     ckey = _fastpath_key_parts(
-                        bool(rd_flag), edns, payload, 1, 1,
-                        data[12:q_end - 4].lower())
+                        bool(rd_flag), edns, payload, 1, 1, qname_low)
                     try:
                         _fastio.fastpath_put(
-                            self._fastpath, ckey, 1, gen, [cache_wire],
-                            int(self.answer_cache.expiry_s * 1000))
+                            self._fastpath, ckey, 1, epoch, [cache_wire],
+                            int(self.answer_cache.expiry_s * 1000),
+                            qname_low)
                     except (TypeError, ValueError, MemoryError) as e:
                         self.log.debug("fastpath push skipped: %s", e)
         except Exception:

@@ -47,6 +47,22 @@ def domain_to_path(domain: str) -> str:
     return "/" + "/".join(reversed(domain.split(".")))
 
 
+def _rev_name(ip: Optional[str]) -> Optional[str]:
+    """'10.1.2.3' -> '3.2.1.10.in-addr.arpa' (the PTR qname an answer
+    for this address is cached under); None for non-IPv4 strings —
+    reverse resolution is IPv4-only (engine.resolve_ptr, matching the
+    reference lib/server.js:71-84).  No canonicalization: the engine
+    does not validate octets either, so a non-canonical stored address
+    ('10.1.2.03') pairs with exactly the reverse qname a client would
+    use to reach it."""
+    if not ip:
+        return None
+    parts = ip.split(".")
+    if len(parts) != 4 or not all(p.isdigit() for p in parts):
+        return None
+    return ".".join(reversed(parts)) + ".in-addr.arpa"
+
+
 class TreeNode:
     """One mirrored znode == one domain label (reference TreeNode)."""
 
@@ -77,6 +93,11 @@ class TreeNode:
         self.cache.bump_gen()
         if self.cache.m_watch_children is not None:
             self.cache.m_watch_children.inc()
+        # answers that may change: this node's own (service answer sets
+        # derive from children) and each newly appearing child's name
+        # (a cached REFUSED for it is now wrong); removed subtrees emit
+        # their own tags from unbind()
+        tags = {self.domain}
         new_kids: Dict[str, TreeNode] = {}
         for kid in kids:
             existing = self.kids.pop(kid, None)
@@ -85,10 +106,12 @@ class TreeNode:
             else:
                 node = TreeNode(self.cache, self.domain, kid)
                 new_kids[kid] = node
+                tags.add(node.domain)
                 node.rebind()
         for removed in list(self.kids.values()):
             removed.unbind()
         self.kids = new_kids
+        self.cache.invalidate(tags)
 
     def on_data_changed(self, data: bytes) -> None:
         self.cache.bump_gen()
@@ -101,13 +124,14 @@ class TreeNode:
                              self.path, e)
             if self.cache.m_parse_failures is not None:
                 self.cache.m_parse_failures.inc()
-            return
+            return                      # old data kept: answers unchanged
         # JS typeof-object check admits dicts, lists, and null
         # (lib/zk.js:149-154); anything else is ignored, keeping old data.
         if parsed is not None and not isinstance(parsed, (dict, list)):
             self.log.warning("ignoring node %s: parsed JSON is not an object",
                              self.path)
             return
+        old_ip = self.ip
         self.data = parsed
 
         rtype = parsed.get("type") if isinstance(parsed, dict) else None
@@ -115,16 +139,27 @@ class TreeNode:
             # no longer (or never was) a host-like record: drop any reverse
             # entry we own so PTR can't serve a stale mapping
             self._drop_rev_entry()
-            return
-        record = parsed.get(rtype)
-        if not isinstance(record, dict):
-            self._drop_rev_entry()
-            return
-        addr = record.get("address")
-        self._drop_rev_entry()
-        self.ip = addr
-        if addr:
-            self.cache.rev_lookup[addr] = self
+        else:
+            record = parsed.get(rtype)
+            if not isinstance(record, dict):
+                self._drop_rev_entry()
+            else:
+                addr = record.get("address")
+                self._drop_rev_entry()
+                self.ip = addr
+                if addr:
+                    self.cache.rev_lookup[addr] = self
+
+        # answers that may change: this name, the parent's (service
+        # answer sets embed child data), and PTR answers for the old and
+        # new address
+        tags = {self.domain}
+        if "." in self.domain:
+            tags.add(self.domain.split(".", 1)[1])
+        for rev in (_rev_name(old_ip), _rev_name(self.ip)):
+            if rev is not None:
+                tags.add(rev)
+        self.cache.invalidate(tags)
 
     def _drop_rev_entry(self) -> None:
         if self.ip and self.cache.rev_lookup.get(self.ip) is self:
@@ -161,8 +196,15 @@ class TreeNode:
             kid.unbind()
         if self.cache.nodes.get(self.domain) is self:
             del self.cache.nodes[self.domain]
+        tags = {self.domain}
+        if "." in self.domain:
+            tags.add(self.domain.split(".", 1)[1])
+        rev = _rev_name(self.ip)
+        if rev is not None:
+            tags.add(rev)
         if self.ip and self.cache.rev_lookup.get(self.ip) is self:
             del self.cache.rev_lookup[self.ip]
+        self.cache.invalidate(tags)
 
 
 class MirrorCache:
@@ -176,12 +218,23 @@ class MirrorCache:
         self.log = log or logging.getLogger("binder.cache")
         self.nodes: Dict[str, TreeNode] = {}
         self.rev_lookup: Dict[str, TreeNode] = {}
-        # generation counter: bumped on every mirrored mutation so answer
-        # caches layered above can invalidate without scanning
+        # generation counter: bumped on every mirrored mutation; drives
+        # the balancer's generation broadcast (its cache entries are
+        # validated against the backend's advertised gen)
         self.gen = 0
+        # epoch: bumped only on full rebuilds (session events), where
+        # arbitrary unseen changes may stream in — the in-process answer
+        # caches key their entries on this and rely on per-name
+        # invalidation (below) for ordinary mutations, so one churning
+        # record no longer evicts every cached answer
+        self.epoch = 0
         # mutation subscribers (e.g. the balancer generation broadcast);
         # called synchronously on every bump — keep them cheap
         self._mutation_cbs: List = []
+        # per-name invalidation subscribers: called with a set of
+        # dependency tags (lookup domains / PTR qnames) whose answers a
+        # mutation may have changed
+        self._invalidate_cbs: List = []
         # store-mirror observability (the reference gets the analogous
         # client metrics by passing its artedi collector into zkstream,
         # lib/zk.js:26-38); all optional — tests build bare caches
@@ -223,6 +276,21 @@ class MirrorCache:
         """Subscribe to generation bumps (any mirrored store mutation)."""
         self._mutation_cbs.append(cb)
 
+    def on_invalidate(self, cb) -> None:
+        """Subscribe to per-name invalidation: cb(tags) where tags is a
+        set of lookup domains / PTR qnames whose answers may have
+        changed (see TreeNode's watch handlers)."""
+        self._invalidate_cbs.append(cb)
+
+    def invalidate(self, tags) -> None:
+        if not tags:
+            return
+        for cb in self._invalidate_cbs:
+            try:
+                cb(tags)
+            except Exception:  # noqa: BLE001 — a subscriber bug must
+                self.log.exception("invalidate callback failed")  # not stop serving
+
     def bump_gen(self) -> None:
         self.gen += 1
         for cb in self._mutation_cbs:
@@ -245,6 +313,9 @@ class MirrorCache:
         (lib/zk.js:68-76)."""
         if self.m_rebuilds is not None:
             self.m_rebuilds.inc()
+        # a (re)session may deliver arbitrary unseen changes while the
+        # subtree re-syncs: conservatively invalidate every cached answer
+        self.epoch += 1
         tn = self.nodes.get(self.domain)
         if tn is None:
             parts = self.domain.split(".")

@@ -284,6 +284,8 @@ static PyMethodDef fastio_methods[] = {
      "fastpath_stats(cache) -> dict"},
     {"fastpath_clear", fastpath_clear, METH_VARARGS,
      "fastpath_clear(cache) -> None"},
+    {"fastpath_invalidate", fastpath_invalidate, METH_VARARGS,
+     "fastpath_invalidate(cache, tag_qname_wire) -> dropped count"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -183,63 +183,94 @@ fastpath_put(PyObject *self, PyObject *args)
 {
     (void)self;
     PyObject *capsule, *wires;
-    Py_buffer keybuf;
+    Py_buffer keybuf, tagbuf;
     unsigned long long gen;
     int qtype;
     long expiry_ms = -1;   /* default: the cache-wide expiry */
 
-    if (!PyArg_ParseTuple(args, "Oy*iKO|l", &capsule, &keybuf, &qtype,
-                          &gen, &wires, &expiry_ms))
+    tagbuf.buf = NULL;
+    tagbuf.len = 0;
+    tagbuf.obj = NULL;
+    if (!PyArg_ParseTuple(args, "Oy*iKO|ly*", &capsule, &keybuf, &qtype,
+                          &gen, &wires, &expiry_ms, &tagbuf))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     if (c == NULL) {
         PyBuffer_Release(&keybuf);
+        if (tagbuf.obj != NULL)
+            PyBuffer_Release(&tagbuf);
         return NULL;
     }
     PyObject *fast = PySequence_Fast(wires, "wires must be a sequence");
     if (fast == NULL) {
         PyBuffer_Release(&keybuf);
+        if (tagbuf.obj != NULL)
+            PyBuffer_Release(&tagbuf);
         return NULL;
     }
     Py_ssize_t nw = PySequence_Fast_GET_SIZE(fast);
-    if (nw < 1 || nw > FP_MAX_VARIANTS) {
-        Py_DECREF(fast);
-        PyBuffer_Release(&keybuf);
-        Py_RETURN_FALSE;
-    }
-    /* borrow the wire pointers (valid while `fast` is held) */
-    const uint8_t *wire_ptrs[FP_MAX_VARIANTS];
-    uint16_t wire_lens[FP_MAX_VARIANTS];
-    for (Py_ssize_t i = 0; i < nw; i++) {
-        char *data;
-        Py_ssize_t dlen;
-        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
-                                    &data, &dlen) < 0) {
-            Py_DECREF(fast);
-            PyBuffer_Release(&keybuf);
-            return NULL;
+    int rc = 0;
+    if (nw >= 1 && nw <= FP_MAX_VARIANTS) {
+        /* borrow the wire pointers (valid while `fast` is held) */
+        const uint8_t *wire_ptrs[FP_MAX_VARIANTS];
+        uint16_t wire_lens[FP_MAX_VARIANTS];
+        int sizes_ok = 1;
+        for (Py_ssize_t i = 0; i < nw; i++) {
+            char *data;
+            Py_ssize_t dlen;
+            if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                        &data, &dlen) < 0) {
+                Py_DECREF(fast);
+                PyBuffer_Release(&keybuf);
+                if (tagbuf.obj != NULL)
+                    PyBuffer_Release(&tagbuf);
+                return NULL;
+            }
+            if (dlen < 12 || dlen > FP_MAX_WIRE) {
+                sizes_ok = 0;       /* oversize answers stay in Python */
+                break;
+            }
+            wire_ptrs[i] = (const uint8_t *)data;
+            wire_lens[i] = (uint16_t)dlen;
         }
-        if (dlen < 12 || dlen > FP_MAX_WIRE) {
-            Py_DECREF(fast);
-            PyBuffer_Release(&keybuf);
-            Py_RETURN_FALSE;            /* oversize answers stay in Python */
+        if (sizes_ok) {
+            double expiry_s = expiry_ms >= 0 ? (double)expiry_ms / 1000.0
+                                             : c->expiry_s;
+            rc = fp_put_raw(c, keybuf.buf, (size_t)keybuf.len,
+                            (uint16_t)qtype, (uint64_t)gen, wire_ptrs,
+                            wire_lens, (int)nw, fp_now(), expiry_s,
+                            (const uint8_t *)tagbuf.buf,
+                            (size_t)tagbuf.len);
         }
-        wire_ptrs[i] = (const uint8_t *)data;
-        wire_lens[i] = (uint16_t)dlen;
     }
-
-    double expiry_s = expiry_ms >= 0 ? (double)expiry_ms / 1000.0
-                                     : c->expiry_s;
-    int rc = fp_put_raw(c, keybuf.buf, (size_t)keybuf.len,
-                        (uint16_t)qtype, (uint64_t)gen, wire_ptrs,
-                        wire_lens, (int)nw, fp_now(), expiry_s);
     Py_DECREF(fast);
     PyBuffer_Release(&keybuf);
+    if (tagbuf.obj != NULL)
+        PyBuffer_Release(&tagbuf);
     if (rc < 0)
         return PyErr_NoMemory();
     if (rc == 0)
         Py_RETURN_FALSE;
     Py_RETURN_TRUE;
+}
+
+PyObject *
+fastpath_invalidate(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    Py_buffer tagbuf;
+
+    if (!PyArg_ParseTuple(args, "Oy*", &capsule, &tagbuf))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&tagbuf);
+        return NULL;
+    }
+    uint32_t n = fp_invalidate_tag(c, tagbuf.buf, (size_t)tagbuf.len);
+    PyBuffer_Release(&tagbuf);
+    return PyLong_FromUnsignedLong((unsigned long)n);
 }
 
 PyObject *

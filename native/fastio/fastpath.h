@@ -28,5 +28,6 @@ PyObject *fastpath_put(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);
+PyObject *fastpath_invalidate(PyObject *self, PyObject *args);
 
 #endif /* BINDER_FASTPATH_H */

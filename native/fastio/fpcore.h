@@ -30,9 +30,20 @@
 #define FP_MAX_TOTAL_BYTES (64u << 20)
 #define FP_QTYPE_OTHER 0xFFFF     /* stats catch-all past FP_MAX_QTYPES */
 
+#define FP_MAX_TAG 256            /* a qname in wire label format */
+
 typedef struct {
     uint8_t key[FP_MAX_KEY];
     uint16_t keylen;
+    /* dependency tag (hashed): the wire-format qname of the store name
+     * this answer derives from (SRV answers are keyed by the full
+     * _svc._proto.name qname but depend on the service node's domain) —
+     * matched by fp_invalidate_tag when that name mutates.  Only the
+     * 64-bit hash is kept: equality is the only operation, and a hash
+     * collision merely drops an extra entry that then re-resolves, so
+     * the always-resident slot table stays small */
+    uint64_t taghash;
+    uint8_t has_tag;
     uint64_t gen;
     double expire_at;
     double inserted_at;
@@ -204,10 +215,13 @@ fp_find(fp_cache_t *c, const uint8_t *key, size_t keylen, uint64_t gen,
 static inline int
 fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
            uint16_t qtype, uint64_t gen, const uint8_t *const *wires,
-           const uint16_t *wire_lens, int nw, double now, double expiry_s)
+           const uint16_t *wire_lens, int nw, double now, double expiry_s,
+           const uint8_t *tag, size_t taglen)
 {
     if (keylen < 8 || keylen > FP_MAX_KEY)
         return 0;                       /* not representable: skip */
+    if (taglen > FP_MAX_TAG)
+        return 0;                       /* not invalidatable: skip */
     if (nw < 1 || nw > FP_MAX_VARIANTS)
         return 0;
     uint64_t add_bytes = 0;
@@ -243,6 +257,8 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
 
     memcpy(target->key, key, keylen);
     target->keylen = (uint16_t)keylen;
+    target->taghash = taglen > 0 ? fp_hash(tag, taglen) : 0;
+    target->has_tag = taglen > 0;
     target->gen = gen;
     target->inserted_at = now;
     target->expire_at = now + expiry_s;
@@ -264,6 +280,30 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
     target->used = 1;
     c->n_entries++;
     return 1;
+}
+
+/*
+ * Drop every entry whose dependency tag equals `tag` (a mirrored store
+ * mutation changed that name's answers).  Full-table scan: mutation
+ * rates (~hundreds/s) times slot counts (thousands) is microseconds of
+ * work, and the scan needs no auxiliary index to stay consistent.
+ * Returns the number of entries dropped.
+ */
+static inline uint32_t
+fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
+{
+    if (taglen == 0 || taglen > FP_MAX_TAG)
+        return 0;
+    uint64_t h = fp_hash(tag, taglen);
+    uint32_t n = 0;
+    for (uint32_t i = 0; i <= c->mask; i++) {
+        fp_entry_t *e = &c->slots[i];
+        if (e->used && e->has_tag && e->taghash == h) {
+            fp_entry_free(c, e);
+            n++;
+        }
+    }
+    return n;
 }
 
 /*

@@ -119,9 +119,23 @@ void fuzz_one(const uint8_t *data, size_t len) {
             lens[i] = (uint16_t)wl;
         }
 
+        /* tag = the query's own qname wire (what the Python pusher does
+         * for host answers); qname starts at key offset 7 */
+        const uint8_t *tag = key + 7;
+        size_t taglen = klen - 7;
         int rc = fp_put_raw(fz_c, key, klen, qtype, fz_gen, wires, lens,
-                            nw, fz_clock, fz_c->expiry_s);
+                            nw, fz_clock, fz_c->expiry_s, tag, taglen);
         assert(rc >= 0);                /* OOM is the only -1 */
+
+        if (rc == 1 && fz_iter % 31 == 0) {
+            /* tag invalidation: the entry just stored must be dropped
+             * and the following serve must miss */
+            uint32_t dropped = fp_invalidate_tag(fz_c, tag, taglen);
+            assert(dropped >= 1);
+            assert(fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock, out,
+                                nullptr) == 0);
+            rc = 0;                     /* skip the hit asserts below */
+        }
 
         if (rc == 1) {
             /* round-trip: serving the same query must hit variant 0 and

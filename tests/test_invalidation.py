@@ -1,0 +1,247 @@
+"""Per-name answer-cache invalidation (tags + epoch).
+
+Correctness under mutation was already covered by test_answer_cache.py;
+this module pins the new *selectivity* property — a mirrored mutation
+drops exactly the answers whose dependency tag it touched, so unrelated
+cached answers survive churn — plus the tag bookkeeping underneath it
+(MirrorCache tag emission, AnswerCache tag index, the native cache's
+fp_invalidate_tag via the _binderfastio module) and the epoch full-drop
+on session rebuilds.
+"""
+import asyncio
+import random
+
+from binder_tpu.dns import Rcode, Type
+from binder_tpu.resolver.answer_cache import AnswerCache
+from binder_tpu.store import FakeStore, MirrorCache
+
+from test_answer_cache import build, udp_ask
+
+DOMAIN = "foo.com"
+
+
+class TestAnswerCacheTags:
+    def test_invalidate_tag_drops_only_matching(self):
+        c = AnswerCache()
+        c.put("k1", 0, "v1", tag="web.foo.com")
+        c.put("k2", 0, "v2", tag="api.foo.com")
+        c.put("k3", 0, "v3", tag="web.foo.com")
+        assert c.invalidate_tag("web.foo.com") == 2
+        assert c.get("k1", 0) is None
+        assert c.get("k2", 0) == "v2"
+        assert c.get("k3", 0) is None
+        # index cleaned: a second invalidation is a no-op
+        assert c.invalidate_tag("web.foo.com") == 0
+
+    def test_eviction_keeps_tag_index_consistent(self):
+        c = AnswerCache(size=2)
+        c.put("k1", 0, "v1", tag="t")
+        c.put("k2", 0, "v2", tag="t")
+        c.put("k3", 0, "v3", tag="t")     # evicts k1
+        assert c.invalidate_tag("t") == 2  # k2, k3 — not the evicted k1
+        assert not c._entries and not c._by_tag
+
+    def test_epoch_mismatch_still_drops(self):
+        c = AnswerCache()
+        c.put("k", 7, "v", tag="t")
+        assert c.get("k", 8) is None       # stale epoch
+        assert not c._entries and not c._by_tag
+
+
+class TestMirrorTagEmission:
+    def collect(self, store_mutations):
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        store.put_json("/com/foo/web",
+                       {"type": "host", "host": {"address": "10.1.2.3"}})
+        store.start_session()
+        seen = []
+        cache.on_invalidate(lambda tags: seen.append(set(tags)))
+        store_mutations(store)
+        return set().union(*seen) if seen else set()
+
+    def test_data_change_emits_name_parent_and_both_rev_names(self):
+        tags = self.collect(lambda s: s.put_json(
+            "/com/foo/web", {"type": "host",
+                             "host": {"address": "10.9.9.9"}}))
+        assert {"web.foo.com", "foo.com",
+                "3.2.1.10.in-addr.arpa",
+                "9.9.9.10.in-addr.arpa"} <= tags
+
+    def test_child_creation_emits_parent_and_child(self):
+        tags = self.collect(lambda s: s.put_json(
+            "/com/foo/api", {"type": "host",
+                             "host": {"address": "10.4.4.4"}}))
+        assert {"api.foo.com", "foo.com"} <= tags
+
+    def test_delete_emits_name_parent_and_rev(self):
+        tags = self.collect(lambda s: s.delete("/com/foo/web"))
+        assert {"web.foo.com", "foo.com",
+                "3.2.1.10.in-addr.arpa"} <= tags
+
+
+class TestSelectiveInvalidation:
+    def test_unrelated_mutation_keeps_cache_hot(self):
+        """The perf property the global generation counter could not
+        give: churn on one name must not evict every cached answer."""
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, 1)
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, 2)
+                hits_before = server.answer_cache.hits
+                # churn a completely different subtree, hard
+                for i in range(50):
+                    store.put_json(
+                        "/com/foo/churny",
+                        {"type": "host",
+                         "host": {"address": f"10.8.0.{i + 1}"}})
+                r = await udp_ask(server.udp_port, "web.foo.com",
+                                  Type.A, 3)
+                hits_after = server.answer_cache.hits
+                return r, hits_before, hits_after
+            finally:
+                await server.stop()
+
+        r, before, after = asyncio.run(run())
+        assert r.answers[0].address == "192.168.0.1"
+        assert after == before + 1     # still a cache hit after 50 mutations
+
+    def test_mutated_name_served_fresh_others_stay_cached(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, 1)
+                r_srv1 = await udp_ask(server.udp_port,
+                                       "_pg._tcp.svc.foo.com", Type.SRV, 2)
+                store.put_json(
+                    "/com/foo/web",
+                    {"type": "host", "host": {"address": "172.16.0.9"}})
+                r_web = await udp_ask(server.udp_port, "web.foo.com",
+                                      Type.A, 3)
+                r_old_ptr = await udp_ask(server.udp_port,
+                                          "1.0.168.192.in-addr.arpa",
+                                          Type.PTR, 4)
+                r_new_ptr = await udp_ask(server.udp_port,
+                                          "9.0.16.172.in-addr.arpa",
+                                          Type.PTR, 5)
+                return r_srv1, r_web, r_old_ptr, r_new_ptr
+            finally:
+                await server.stop()
+
+        r_srv, r_web, r_old_ptr, r_new_ptr = asyncio.run(run())
+        assert r_web.answers[0].address == "172.16.0.9"
+        assert r_old_ptr.rcode == Rcode.REFUSED
+        assert r_new_ptr.answers[0].target == "web.foo.com"
+        assert len(r_srv.answers) == 4
+
+    def test_service_child_add_refreshes_parent_answers(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            try:
+                # warm the rotation set fully (4 LBs, rotatable entries
+                # need the variant set collected)
+                for i in range(12):
+                    await udp_ask(server.udp_port, "svc.foo.com",
+                                  Type.A, 10 + i)
+                store.put_json("/com/foo/svc/lb99",
+                               {"type": "load_balancer",
+                                "load_balancer": {"address": "10.0.1.99"}})
+                seen = set()
+                for i in range(12):
+                    r = await udp_ask(server.udp_port, "svc.foo.com",
+                                      Type.A, 40 + i)
+                    seen.update(a.address for a in r.answers)
+                return seen
+            finally:
+                await server.stop()
+
+        seen = asyncio.run(run())
+        assert "10.0.1.99" in seen
+
+    def test_session_rebuild_epoch_drops_everything(self):
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, 1)
+                await udp_ask(server.udp_port, "web.foo.com", Type.A, 2)
+                epoch_before = cache.epoch
+                cache.rebuild()          # session event
+                assert cache.epoch == epoch_before + 1
+                hits_before = server.answer_cache.hits
+                r = await udp_ask(server.udp_port, "web.foo.com",
+                                  Type.A, 3)
+                return r, hits_before, server.answer_cache.hits
+            finally:
+                await server.stop()
+
+        r, before, after = asyncio.run(run())
+        assert r.answers[0].address == "192.168.0.1"
+        assert after == before           # re-resolved, not served stale
+
+
+class TestNativeTagInvalidation:
+    def test_fastpath_invalidate_by_tag(self):
+        try:
+            from binder_tpu import _binderfastio as fastio
+        except ImportError:
+            import pytest
+            pytest.skip("_binderfastio not built")
+        cap = fastio.fastpath_new(64, 60000, [0.001, 0.01], [100.0])
+        # key layout: [flags][payload BE16][qtype BE16][qclass BE16][qname]
+        qname = b"\x03web\x03foo\x03com\x00"
+        key = bytes([1, 0x04, 0xd0, 0, 1, 0, 1]) + qname
+        wire = b"\x00\x00\x84\x00\x00\x01\x00\x01\x00\x00\x00\x00" \
+            + qname + b"\x00\x01\x00\x01" + b"\xc0\x0c\x00\x01\x00\x01" \
+            + b"\x00\x00\x00\x1e\x00\x04\x0a\x01\x02\x03"
+        assert fastio.fastpath_put(cap, key, 1, 0, [wire], -1, qname)
+        assert fastio.fastpath_stats(cap)["entries"] == 1
+        # wrong tag: nothing dropped
+        assert fastio.fastpath_invalidate(
+            cap, b"\x03api\x03foo\x03com\x00") == 0
+        assert fastio.fastpath_stats(cap)["entries"] == 1
+        # right tag
+        assert fastio.fastpath_invalidate(cap, qname) == 1
+        assert fastio.fastpath_stats(cap)["entries"] == 0
+
+
+class TestDifferentialChurn:
+    def test_random_churn_never_serves_stale(self):
+        """Randomized soak: interleave mutations and queries; every
+        answer must reflect the store state at query time (the fake
+        store delivers watches synchronously, so there is no propagation
+        window to excuse)."""
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            rng = random.Random(7)
+            state = {}
+            try:
+                for step in range(300):
+                    name = f"h{rng.randrange(8)}"
+                    if rng.random() < 0.4:
+                        addr = f"10.5.{rng.randrange(256)}.{rng.randrange(1, 255)}"
+                        store.put_json(
+                            f"/com/foo/{name}",
+                            {"type": "host", "host": {"address": addr}})
+                        state[name] = addr
+                    elif rng.random() < 0.15 and name in state:
+                        store.delete(f"/com/foo/{name}")
+                        del state[name]
+                    r = await udp_ask(server.udp_port,
+                                      f"{name}.foo.com", Type.A,
+                                      step % 65536)
+                    if name in state:
+                        assert [a.address for a in r.answers] == \
+                            [state[name]], f"step {step}: stale answer"
+                    else:
+                        assert r.rcode == Rcode.REFUSED, \
+                            f"step {step}: expected REFUSED"
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
