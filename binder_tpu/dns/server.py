@@ -55,13 +55,24 @@ def pack_balancer_frame(family: int, addr: str, port: int,
 
 
 def pack_gen_frame(gen: int) -> bytes:
-    """Control frame reporting the mirror-cache generation to the
-    balancer (family 0 marks control; the transport byte is the opcode,
-    0 = generation report; the 16-byte address field carries the
-    generation, big-endian, in its first 8 bytes).  The balancer uses it
-    to invalidate its answer cache (docs/balancer-protocol.md)."""
+    """Control frame reporting the mirror-cache generation (epoch) to
+    the balancer (family 0 marks control; the transport byte is the
+    opcode, 0 = generation report; the 16-byte address field carries the
+    generation, big-endian, in its first 8 bytes).  An advance tells the
+    balancer every cached entry from this backend is stale
+    (docs/balancer-protocol.md)."""
     return struct.pack(">IBBB16sH", BALANCER_HDR, BALANCER_VERSION, 0, 0,
                        (gen & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"), 0)
+
+
+def pack_invalidate_frame(tag_wire: bytes) -> bytes:
+    """Control frame (opcode 1) invalidating one dependency tag at the
+    balancer: the payload after the frame header is the lowercased
+    qname-wire form of the store name whose answers a mutation changed
+    (docs/balancer-protocol.md)."""
+    return struct.pack(">IBBB16sH", BALANCER_HDR + len(tag_wire),
+                       BALANCER_VERSION, 0, 1, b"\x00" * 16,
+                       0) + tag_wire
 
 
 def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
@@ -140,14 +151,20 @@ class DnsServer:
         self.fastpath = None
         self.fastpath_gen: Optional[Callable[[], int]] = None
         self.fastpath_gate: Optional[Callable[[], bool]] = None
-        # Balancer answer-cache support: generation frames let the
-        # balancer cache responses with backend-driven invalidation.
-        # `gen_source` supplies the current generation; notify_mutation
-        # (wired to MirrorCache.on_mutation) broadcasts it, coalesced to
-        # one frame per event-loop turn.
+        # Balancer answer-cache support: control frames let the balancer
+        # cache responses with backend-driven invalidation.
+        # `gen_source` supplies the current generation/epoch;
+        # notify_mutation (wired to MirrorCache.on_mutation) broadcasts
+        # it, coalesced to one frame per event-loop turn.
+        # notify_invalidate (wired to MirrorCache.on_invalidate)
+        # broadcasts per-name invalidate frames (opcode 1), coalesced
+        # the same way, so ordinary store churn drops only the affected
+        # balancer entries.
         self.gen_source: Optional[Callable[[], int]] = None
         self._balancer_writers: dict = {}   # writer -> per-conn write lock
         self._gen_dirty = False
+        self._pending_inval: set = set()    # tag wires awaiting broadcast
+        self._last_gen_sent: Optional[int] = None
 
     # -- shared query dispatch --
     #
@@ -536,11 +553,42 @@ class DnsServer:
         self._gen_dirty = True
         loop.call_soon(self._send_gen_frames)
 
-    def _send_gen_frames(self) -> None:
-        self._gen_dirty = False
-        if self.gen_source is None:
+    def notify_invalidate(self, tag_wires) -> None:
+        """Broadcast per-name invalidate frames (opcode 1) to every
+        balancer link, coalesced per event-loop turn like the generation
+        report — and through the same ordered write path, so a response
+        computed under pre-mutation data (whose write task exists before
+        the mutation ran) always reaches the balancer before the frame
+        that would invalidate it."""
+        if not self._balancer_writers:
             return
-        frame = pack_gen_frame(self.gen_source())
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return   # no loop: no balancer link is being served either
+        schedule = not self._pending_inval and not self._gen_dirty
+        self._pending_inval.update(tag_wires)
+        if schedule and self._pending_inval:
+            loop.call_soon(self._send_gen_frames)
+
+    def _send_gen_frames(self) -> None:
+        gen_dirty = self._gen_dirty
+        self._gen_dirty = False
+        pending = self._pending_inval
+        self._pending_inval = set()
+        frame = b""
+        if gen_dirty and self.gen_source is not None:
+            # mutations mark dirty but the reported value is the epoch,
+            # which only moves on rebuilds — skip the no-op frame the
+            # balancer would ignore anyway
+            val = self.gen_source()
+            if val != self._last_gen_sent:
+                frame += pack_gen_frame(val)
+                self._last_gen_sent = val
+        for tag in sorted(pending):
+            frame += pack_invalidate_frame(tag)
+        if not frame:
+            return
         for writer, lock in list(self._balancer_writers.items()):
             # the frame must go through the same ordered write path as
             # responses: a bare write could overtake a response computed
@@ -568,7 +616,11 @@ class DnsServer:
         if self.gen_source is not None:
             # report our generation immediately so the balancer can cache
             # from the first response
-            writer.write(pack_gen_frame(self.gen_source()))
+            # connect-time report is per-link and unconditional (a fresh
+            # balancer knows nothing); it also seeds the dedupe tracker
+            val = self.gen_source()
+            writer.write(pack_gen_frame(val))
+            self._last_gen_sent = val
             self._balancer_writers[writer] = lock
         try:
             while True:

@@ -180,10 +180,13 @@ class BinderServer:
         # truth — completed answer-cache entries are pushed down in
         # _on_query, and the C-side counters fold into the same
         # Prometheus collectors at scrape time (_fold_fastpath_metrics).
-        # Balancer answer-cache support: report our mirror generation
-        # over balancer links so the balancer can cache responses with
-        # correct invalidation (docs/balancer-protocol.md control frames)
-        self.engine.gen_source = lambda: self.zk_cache.gen
+        # Balancer answer-cache support: the generation report carries
+        # the mirror *epoch* (full-rebuild counter), so the balancer
+        # only drops everything when a re-mirror really happened;
+        # ordinary mutations ride the per-name invalidate frames
+        # broadcast from _on_store_invalidate
+        # (docs/balancer-protocol.md control frames)
+        self.engine.gen_source = lambda: self.zk_cache.epoch
         if hasattr(zk_cache, "on_mutation"):
             zk_cache.on_mutation(self.engine.notify_mutation)
         # Per-name invalidation: a mirrored mutation drops exactly the
@@ -291,16 +294,23 @@ class BinderServer:
 
     def _on_store_invalidate(self, tags) -> None:
         """MirrorCache invalidation subscriber: drop the cached answers
-        whose dependency tag a store mutation touched."""
+        whose dependency tag a store mutation touched — in the Python
+        answer cache, the native fast path, and (via opcode-1 control
+        frames) the balancer's cache."""
+        wires = []
         for tag in tags:
             self.answer_cache.invalidate_tag(tag)
+            wire = self._qname_wire(tag)
+            if wire is None:
+                continue
+            wires.append(wire)
             if self._fastpath is not None:
-                wire = self._qname_wire(tag)
-                if wire is not None:
-                    try:
-                        _fastio.fastpath_invalidate(self._fastpath, wire)
-                    except (TypeError, ValueError):
-                        pass
+                try:
+                    _fastio.fastpath_invalidate(self._fastpath, wire)
+                except (TypeError, ValueError):
+                    pass
+        if wires:
+            self.engine.notify_invalidate(wires)
 
     def _fastpath_push(self, key, epoch: int, query: QueryCtx,
                        tag: str) -> None:

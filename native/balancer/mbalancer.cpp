@@ -48,6 +48,7 @@
 #include <map>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "../common/dnskey.h"
@@ -214,12 +215,65 @@ struct CacheEntry {
      * and only then served, cycling through them.  Single-answer
      * entries are complete at one variant. */
     std::vector<std::vector<uint8_t>> wires;
+    /* dependency-tag hash: the store name this answer derives from,
+     * derived from the key at fill time (cache_tag_hash); matched by
+     * the backend's per-name invalidate control frames (opcode 1) */
+    uint64_t taghash = 0;
     uint8_t next_variant = 0;
     bool complete = false;
     size_t bytes = 0;
 };
 constexpr size_t kCacheVariants = 8;
 uint64_t g_cache_bytes = 0;           /* across all backends */
+
+uint64_t fnv64(const uint8_t *p, size_t n) {
+    uint64_t h = 1469598103934665603ull;        /* FNV-1a 64 */
+    for (size_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+/*
+ * Dependency-tag hash for a cache key (dnskey layout: qtype at [3:5],
+ * lowercased qname wire from [7]).  The tag is the store name the
+ * answer derives from: for SRV qnames the resolver strips the leading
+ * _service._proto labels and looks up the remainder
+ * (binder_tpu/resolver/engine.py SRV_RE), so the tag is that suffix of
+ * the label chain; for everything else (A-likes, PTR reverse names)
+ * the qname itself.  Must stay in lockstep with the tag wires the
+ * backend emits (BinderServer._on_store_invalidate -> opcode 1).
+ */
+uint64_t cache_tag_hash(const std::string &mkey) {
+    const uint8_t *k = (const uint8_t *)mkey.data();
+    size_t n = mkey.size();
+    if (n < 8)
+        return 0;
+    uint16_t qtype = (uint16_t)((k[3] << 8) | k[4]);
+    const uint8_t *qn = k + 7;
+    size_t qlen = n - 7;
+    if (qtype == 33) {                  /* SRV */
+        const uint8_t *p = qn;
+        size_t rem = qlen;
+        int stripped = 0;
+        for (int i = 0; i < 2; i++) {
+            if (rem < 2 || p[0] == 0 || p[1] != '_')
+                break;
+            size_t l = p[0];
+            if (1 + l >= rem)
+                break;
+            p += 1 + l;
+            rem -= 1 + l;
+            stripped++;
+        }
+        if (stripped == 2 && rem > 1) {
+            qn = p;
+            qlen = rem;
+        }
+    }
+    return fnv64(qn, qlen);
+}
 constexpr size_t kMaxCacheEntriesPerBackend = 65536;
 constexpr uint64_t kMaxCacheBytes = 64ull << 20;
 constexpr size_t kMaxCacheWire = 4096;
@@ -303,6 +357,7 @@ struct Balancer {
 
     uint64_t udp_queries = 0, tcp_queries = 0, drops = 0;
     uint64_t cache_hits = 0;
+    uint64_t cache_invalidations = 0;  /* entries dropped by opcode 1 */
     uint64_t wq_overflows = 0;    /* frames refused: stream at byte cap */
     uint64_t idle_closes = 0;     /* TCP clients evicted for idleness */
     uint64_t client_evictions = 0; /* evicted to admit a new client */
@@ -609,8 +664,10 @@ void backend_cache_insert(Backend &be, const uint8_t *key, size_t keylen,
         backend_cache_clear(*fat);
     }
     CacheEntry &e = be.cache[mkey];
-    if (e.wires.empty())
+    if (e.wires.empty()) {
         e.expire_at = mono_s() + (double)g_bal.cache_ms / 1000.0;
+        e.taghash = cache_tag_hash(mkey);
+    }
     e.wires.emplace_back(wire, wire + len);
     e.bytes += len;
     g_cache_bytes += len;
@@ -1086,6 +1143,7 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
     rb.insert(rb.end(), buf, buf + n);
     size_t off = 0;
     bool ok = true;
+    std::unordered_set<uint64_t> pending_inval;
     while (rb.size() - off >= 4) {
         uint32_t L;
         memcpy(&L, rb.data() + off, 4);
@@ -1103,8 +1161,14 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
             break;
         }
         if (f[1] == 0) {
-            /* control frame; opcode in the transport byte.  0 =
-             * generation report: 8 bytes BE in the address field */
+            /* control frame; opcode in the transport byte (unknown
+             * opcodes are skipped so the channel can grow).
+             * 0 = generation (epoch) report: 8 bytes BE in the address
+             * field; an advance means a full re-mirror — every cached
+             * entry from this backend is stale.
+             * 1 = per-name invalidate: the payload after the frame
+             * header is the tag qname wire; drop exactly the entries
+             * whose answers derive from it (ordinary store churn). */
             if (f[2] == 0 && L >= kFrameHdr) {
                 uint64_t g = 0;
                 for (int b = 0; b < 8; b++)
@@ -1113,6 +1177,15 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
                     backend_cache_clear(be);   /* all entries stale */
                 be.gen = g;
                 be.gen_known = true;
+            } else if (f[2] == 1 && L > kFrameHdr) {
+                size_t tlen = L - kFrameHdr;
+                if (tlen >= 2 && tlen <= 256)
+                    /* batched: applied in one cache scan after the
+                     * frame loop — the backend coalesces one flush of
+                     * tags per mutation turn, which arrives as one
+                     * read, so churn costs one scan per turn, not one
+                     * per tag */
+                    pending_inval.insert(fnv64(f + kFrameHdr, tlen));
             }
             off += 4 + L;
             continue;
@@ -1131,6 +1204,18 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
     /* batched UDP responses reference rb — flush before it mutates */
     udp_out_flush();
     if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
+    if (!pending_inval.empty()) {
+        for (auto it = be.cache.begin(); it != be.cache.end(); ) {
+            if (pending_inval.count(it->second.taghash) != 0) {
+                g_cache_bytes -= it->second.bytes;
+                be.cache_bytes -= it->second.bytes;
+                g_bal.cache_invalidations++;
+                it = be.cache.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
     return ok;
 }
 
@@ -1190,6 +1275,7 @@ void handle_stats() {
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
                  "  \"cache_hits\": %llu,\n  \"cache_entries\": %zu,\n"
+                 "  \"cache_invalidations\": %llu,\n"
                  "  \"tcp_clients\": %zu,\n  \"wq_overflows\": %llu,\n"
                  "  \"idle_closes\": %llu,\n"
                  "  \"client_evictions\": %llu,\n"
@@ -1204,6 +1290,7 @@ void handle_stats() {
                       for (const auto &b : g_bal.backends)
                           n += b.cache.size();
                       return n; }(),
+                 (unsigned long long)g_bal.cache_invalidations,
                  g_bal.tcp_clients.size(),
                  (unsigned long long)g_bal.wq_overflows,
                  (unsigned long long)g_bal.idle_closes,

@@ -140,6 +140,77 @@ def test_zk_to_balancer_full_chain(tmp_path):
     asyncio.run(run())
 
 
+def test_balancer_invalidation_is_per_name(tmp_path):
+    """Ordinary churn must drop only the affected balancer entries
+    (opcode-1 per-name invalidate frames): after mutating one name over
+    the real ZK protocol, the other name keeps serving from the
+    balancer cache, and the stats socket reports the selective drop."""
+    sockdir = str(tmp_path)
+
+    async def run():
+        zkserver = ZKTestServer()
+        await zkserver.start()
+        writer = ZKClient(address="127.0.0.1", port=zkserver.port)
+        writer.start()
+        assert await wait_for(writer.is_connected)
+        await put_json(writer, "/com/foo/web",
+                       {"type": "host", "host": {"address": "10.5.0.1"}})
+        await put_json(writer, "/com/foo/api",
+                       {"type": "host", "host": {"address": "10.5.0.2"}})
+
+        client = ZKClient(address="127.0.0.1", port=zkserver.port,
+                          session_timeout_ms=2000)
+        cache = MirrorCache(client, DOMAIN)
+        client.start()
+        server = BinderServer(
+            zk_cache=cache, dns_domain=DOMAIN, datacenter_name="dc0",
+            host="127.0.0.1", port=0,
+            balancer_socket=os.path.join(sockdir, "0"),
+            collector=MetricsCollector())
+        await server.start()
+        assert await wait_for(
+            lambda: cache.lookup("api.foo.com") is not None
+            and cache.lookup("api.foo.com").data is not None)
+
+        proc, port = await start_balancer(sockdir)
+        try:
+            await asyncio.sleep(0.4)
+            # fill the balancer cache for both names
+            for qid, name in ((1, "web.foo.com"), (2, "api.foo.com"),
+                              (3, "web.foo.com"), (4, "api.foo.com")):
+                m = await udp_ask(port, name, Type.A, qid=qid)
+                assert m.rcode == Rcode.NOERROR
+            hits0 = read_stats(sockdir)["cache_hits"]
+            assert hits0 >= 2
+
+            # mutate web only
+            await writer.set_data("/com/foo/web", json.dumps(
+                {"type": "host",
+                 "host": {"address": "10.5.0.88"}}).encode())
+            assert await wait_for(
+                lambda: cache.lookup("web.foo.com").data["host"]["address"]
+                == "10.5.0.88")
+            # control-frame delivery: poll the stats socket, no sleeps
+            assert await wait_for(
+                lambda: read_stats(sockdir)["cache_invalidations"] >= 1)
+            # api survived the churn: next ask is another balancer hit
+            m = await udp_ask(port, "api.foo.com", Type.A, qid=10)
+            assert m.answers[0].address == "10.5.0.2"
+            assert read_stats(sockdir)["cache_hits"] > hits0
+            # web re-resolves fresh
+            m = await udp_ask(port, "web.foo.com", Type.A, qid=11)
+            assert m.answers[0].address == "10.5.0.88"
+        finally:
+            proc.kill()
+            await proc.wait()
+            await server.stop()
+            client.close()
+            writer.close()
+            await zkserver.stop()
+
+    asyncio.run(run())
+
+
 def test_recursion_through_balancer_not_cached(tmp_path):
     """Cross-DC recursion behind the balancer: answers forwarded from a
     remote binder are served but carry the do-not-store marker, so the
