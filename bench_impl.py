@@ -348,13 +348,20 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
                             "host": {"address": f"10.9.0.{i + 1}"}}
                            ).encode())
 
+        churn_sockdir = os.path.join(tmpdir, "churn_sock")
+        os.mkdir(churn_sockdir)
         config = os.path.join(tmpdir, "churn_config.json")
         with open(config, "w") as f:
             json.dump({"dnsDomain": "bench.com", "datacenterName": "dc0",
                        "host": "127.0.0.1",
                        "store": {"backend": "zookeeper",
                                  "host": "127.0.0.1", "port": zk_port},
-                       "queryLog": False}, f)
+                       "queryLog": False,
+                       # also serve the balancer socket so the same
+                       # churn run can measure the balancer-fronted path
+                       # (per-name opcode-1 invalidation)
+                       "balancerSocket": os.path.join(churn_sockdir,
+                                                      "0")}, f)
         srv_proc = _launch_server(config)
         port = wait_for_port(srv_proc)
 
@@ -418,6 +425,48 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
             p99s.append(r["p99_us"])
             p50s.append(r["p50_us"])
         elapsed = time.perf_counter() - t0
+        # snapshot with elapsed: the churner keeps running through the
+        # balancer windows below, and a later read would inflate the
+        # mutations/s figure
+        direct_mutations = mutations
+
+        # balancer-fronted path under the same sustained churn: the
+        # opcode-1 per-name invalidation keeps the balancer cache hot
+        # for the unmutated names (docs/balancer-protocol.md).  First
+        # window warms the balancer cache, the second is reported.
+        # Supplementary like the topology axis: a failure here logs and
+        # drops only these figures, never the already-measured direct
+        # churn numbers.
+        topo_qps = topo_p99 = None
+        bal = None
+        if os.access(MBALANCER, os.X_OK):
+            try:
+                # launch + PORT wait off-loop: a wedged balancer must not
+                # stall the churner/ZK pings for the 30s line deadline
+                bal, bal_port = await asyncio.to_thread(
+                    _launch_balancer, churn_sockdir)
+                await asyncio.sleep(0.5)   # backend scan + connect
+                for i in range(2):
+                    blast = await asyncio.create_subprocess_exec(
+                        DNSBLAST, "-p", str(bal_port), "-n",
+                        str(N_QUERIES), "-w", str(CONCURRENCY),
+                        "-t", tmpl,
+                        stdout=asyncio.subprocess.PIPE,
+                        stderr=asyncio.subprocess.DEVNULL)
+                    out, _ = await blast.communicate()
+                    if blast.returncode != 0:
+                        raise RuntimeError(
+                            "dnsblast failed under balancer churn")
+                    r = json.loads(out)
+                topo_qps = r["qps"]
+                topo_p99 = r["p99_us"]
+            except Exception as e:  # noqa: BLE001 — supplementary axis
+                print(f"bench: balancer-churn axis failed: {e!r}",
+                      file=sys.stderr)
+            finally:
+                if bal is not None:
+                    _reap(bal)
+
         stop.set()
         if churn_task.done() and churn_task.exception() is not None:
             # the churner died mid-run: these windows were NOT measured
@@ -425,9 +474,13 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
             raise RuntimeError(
                 f"churner failed mid-run: {churn_task.exception()!r}")
         churn_task.cancel()
-        return {"qps": total / elapsed, "p50_us": sorted(p50s)[1],
-                "p99_us": max(p99s), "mutations": mutations,
-                "mutations_per_s": mutations / elapsed}
+        out = {"qps": total / elapsed, "p50_us": sorted(p50s)[1],
+               "p99_us": max(p99s), "mutations": direct_mutations,
+               "mutations_per_s": direct_mutations / elapsed}
+        if topo_qps is not None:
+            out["topo_qps"] = topo_qps
+            out["topo_p99_us"] = topo_p99
+        return out
     finally:
         if writer is not None:
             writer.close()
@@ -441,6 +494,22 @@ def _bench_churn(tmpdir: str) -> Dict[str, float]:
 
 
 MBALANCER = os.path.join(ROOT, "native", "build", "mbalancer")
+
+
+def _launch_balancer(sockdir: str):
+    """Start mbalancer on an ephemeral port fronting `sockdir`; returns
+    (proc, port).  Shared by the topology and balancer-churn axes so
+    both measure an identically configured balancer."""
+    bal = subprocess.Popen(
+        [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+         "-s", "300"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    try:
+        port = _wait_for_line(bal, rb"PORT (\d+)\n", "mbalancer")
+    except Exception:
+        _reap(bal)
+        raise
+    return bal, port
 
 
 def _bench_topology(tmpdir: str) -> Dict[str, float]:
@@ -468,12 +537,8 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
             p = _launch_server(config)
             procs.append(p)
             wait_for_port(p)
-        bal = subprocess.Popen(
-            [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-             "-s", "300"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        bal, port = _launch_balancer(sockdir)
         procs.append(bal)
-        port = _wait_for_line(bal, rb"PORT (\d+)\n", "mbalancer")
         time.sleep(0.5)   # backend scan + connect
         res = None
         for _ in range(2):   # pass 1 warms the balancer cache
@@ -581,11 +646,16 @@ def run_bench() -> Dict[str, object]:
         out["miss_queries"] = N_MISS
     if churn is not None:
         # hot mix under sustained store mutation via the real ZK wire
-        # protocol: watch delivery + generation invalidation under load
+        # protocol: watch delivery + per-name invalidation under load
         out["churn_qps"] = round(churn["qps"], 1)
         out["churn_p50_us"] = round(churn["p50_us"], 1)
         out["churn_p99_us"] = round(churn["p99_us"], 1)
         out["churn_mutations_per_s"] = round(churn["mutations_per_s"], 1)
+        if "topo_qps" in churn:
+            # the same churn through the balancer (opcode-1 per-name
+            # invalidation keeps its cache hot for unmutated names)
+            out["churn_topology_qps"] = round(churn["topo_qps"], 1)
+            out["churn_topology_p99_us"] = round(churn["topo_p99_us"], 1)
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm
         out["topology_qps"] = round(topo["qps"], 1)
