@@ -465,7 +465,10 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
                       file=sys.stderr)
             finally:
                 if bal is not None:
-                    _reap(bal)
+                    # off-loop like the launch: a wedged balancer's
+                    # kill/wait must not stall the churner into session
+                    # expiry and poison the direct figures
+                    await asyncio.to_thread(_reap, bal)
 
         stop.set()
         if churn_task.done() and churn_task.exception() is not None:

@@ -371,22 +371,24 @@ class BinderServer:
 
     def _raw_lane(self, data: bytes, src, protocol: str, send,
                   client_transport: Optional[str] = None) -> bool:
-        """Direct-assembly resolve for the dominant query shape: one
-        A/IN question, optionally with a bare EDNS OPT.
+        """Direct-assembly resolve for the dominant query shapes: one
+        A/IN or PTR/IN question, optionally with a bare EDNS OPT.
 
         The generic path costs ~60µs per cold name (Message decode,
         QueryCtx, resolver, Message encode); this lane answers the same
         shapes in a few µs by patching the request wire: header rewrite,
-        verbatim question echo, one compression-pointer A record.  It
-        mirrors ``Resolver.resolve``'s policy exactly for the shapes it
-        accepts — suffix / doubled-suffix REFUSED, store-down SERVFAIL,
-        TTL precedence, REFUSED-not-NXDOMAIN on misses
-        (lib/server.js:227-241) — and is differential-tested against the
-        generic path (tests/test_raw_lane.py).  Everything else —
-        other qtypes, EDNS options, service/database records, the
-        recursion handoff, invalid records, query-log/probes active —
-        returns False and takes the generic path, so divergence is
-        impossible for declined shapes.
+        verbatim question echo, one compression-pointer A or PTR record.
+        It mirrors ``Resolver.resolve`` / ``Resolver.resolve_ptr``
+        policy exactly for the shapes it accepts — suffix /
+        doubled-suffix REFUSED (forward only; the reverse tree has no
+        suffix policy), store-down SERVFAIL, TTL precedence,
+        REFUSED-not-NXDOMAIN on misses (lib/server.js:227-241) — and is
+        differential-tested against the generic path
+        (tests/test_raw_lane.py).  Everything else — other qtypes, EDNS
+        options, service/database records, the recursion handoff,
+        invalid records, responses that would need UDP truncation,
+        query-log/probes active — returns False and takes the generic
+        path, so divergence is impossible for declined shapes.
 
         One deliberate improvement over the generic path: the question
         section is echoed with the requester's original case (dns0x20
@@ -433,7 +435,12 @@ class BinderServer:
                 return False
         if off + 4 > n:
             return False
-        if data[off:off + 4] != b"\x00\x01\x00\x01":   # A / IN only
+        qtype_b = data[off:off + 4]
+        if qtype_b == b"\x00\x01\x00\x01":       # A / IN
+            qtype_val = 1
+        elif qtype_b == b"\x00\x0c\x00\x01":     # PTR / IN
+            qtype_val = 12
+        else:
             return False
         q_end = off + 4
         edns = False
@@ -465,7 +472,7 @@ class BinderServer:
         udp_sem = (protocol == "udp"
                    or (protocol == "balancer" and client_transport != "tcp"))
         # the key layout must stay byte-for-byte with _on_query's
-        key = (udp_sem, bool(rd_flag), 1, 1, name, edns, payload)
+        key = (udp_sem, bool(rd_flag), qtype_val, 1, name, edns, payload)
         cache = self.zk_cache
         epoch = cache.epoch
         hit = self.answer_cache.get(key, epoch)
@@ -482,66 +489,122 @@ class BinderServer:
                 self._cache_hit_child.inc()
                 self._lane_finish(data, src, protocol, start, wire,
                                   wire[3] & 0x0F, edns, hit[1], hit[2],
-                                  cached=True)
+                                  qtype=qtype_val, cached=True)
             except Exception:
                 # response already sent: never fall through to the
                 # generic path (it would answer a second time)
                 self.log.exception("raw lane post-send bookkeeping failed")
             return True
 
-        # -- resolution (mirrors Resolver.resolve ordering exactly) --
-        rcode = 0
-        node = None
-        if not name.endswith(dd_suffix):
-            rcode = Rcode.REFUSED      # not within dns domain suffix
+        # -- resolution --
+        body = b""
+        ancount = 0
+        ans = []
+        if qtype_val == 1:
+            # mirrors Resolver.resolve ordering exactly
+            rcode = 0
+            node = None
+            if not name.endswith(dd_suffix):
+                rcode = Rcode.REFUSED  # not within dns domain suffix
+            else:
+                stripped = name[:-len(dd_suffix)]
+                dd = self.resolver.dns_domain
+                if (stripped == dd or stripped.endswith(dd_suffix)
+                        or stripped == self._lane_dcsuff
+                        or stripped.endswith("." + self._lane_dcsuff)):
+                    rcode = Rcode.REFUSED  # doubled-up dns domain suffix
+                elif not cache.is_ready():
+                    self.log.error("no coordination-store session")
+                    rcode = Rcode.SERVFAIL
+                else:
+                    node = cache.lookup(name)
+                    if node is None:
+                        if (self.resolver.recursion is not None
+                                and rd_flag):
+                            return False  # recursion handoff: generic
+                        rcode = Rcode.REFUSED
+
+            if rcode == 0 and node is not None:
+                record = node.data
+                rt = record.get("type") if type(record) is dict else None
+                if rt not in _LANE_HOST_TYPES:
+                    return False       # service/database/invalid record
+                sub = record.get(rt)
+                if type(sub) is not dict:
+                    return False
+                addr = sub.get("address")
+                if type(addr) is not str:
+                    return False
+                try:
+                    packed = _socket.inet_aton(addr)
+                except (OSError, TypeError):
+                    return False       # generic path SERVFAILs
+                if _socket.inet_ntoa(packed) != addr:
+                    return False       # non-canonical dotted quad
+                ttl = record.get("ttl")
+                sttl = sub.get("ttl")
+                if sttl is not None:
+                    ttl = sttl
+                elif ttl is None:
+                    ttl = DEFAULT_TTL
+                if type(ttl) is not int:
+                    return False       # store garbage: generic path
+                body = (b"\xc0\x0c\x00\x01\x00\x01"
+                        + struct.pack(">IH", ttl & 0xFFFFFFFF, 4)
+                        + packed)
+                ancount = 1
+                ans = [f"{strip_suffix(dd_suffix, name)} A {addr}"]
         else:
-            stripped = name[:-len(dd_suffix)]
-            dd = self.resolver.dns_domain
-            if (stripped == dd or stripped.endswith(dd_suffix)
-                    or stripped == self._lane_dcsuff
-                    or stripped.endswith("." + self._lane_dcsuff)):
-                rcode = Rcode.REFUSED  # doubled-up dns domain suffix
+            # PTR: mirrors Resolver.resolve_ptr exactly — note there is
+            # NO dnsDomain suffix policy on the reverse tree
+            # (lib/server.js:67-134)
+            rcode = 0
+            parts = name.split(".")
+            if len(parts) < 2 or parts[-1] != "arpa" \
+                    or parts[-2] != "in-addr":
+                rcode = Rcode.REFUSED  # not an ipv4 reverse name
             elif not cache.is_ready():
                 self.log.error("no coordination-store session")
                 rcode = Rcode.SERVFAIL
             else:
-                node = cache.lookup(name)
+                # no octet validation: an invalid address simply misses
+                # (comment at lib/server.js:79-83)
+                ip = ".".join(reversed(parts[:-2]))
+                node = cache.reverse_lookup(ip)
                 if node is None:
                     if self.resolver.recursion is not None and rd_flag:
                         return False   # recursion handoff: generic path
                     rcode = Rcode.REFUSED
-
-        body = b""
-        ancount = 0
-        addr = None
-        if rcode == 0 and node is not None:
-            record = node.data
-            rt = record.get("type") if type(record) is dict else None
-            if rt not in _LANE_HOST_TYPES:
-                return False           # service/database/invalid record
-            sub = record.get(rt)
-            if type(sub) is not dict:
-                return False
-            addr = sub.get("address")
-            if type(addr) is not str:
-                return False
-            try:
-                packed = _socket.inet_aton(addr)
-            except (OSError, TypeError):
-                return False           # generic path SERVFAILs
-            if _socket.inet_ntoa(packed) != addr:
-                return False           # non-canonical dotted quad
-            ttl = record.get("ttl")
-            sttl = sub.get("ttl")
-            if sttl is not None:
-                ttl = sttl
-            elif ttl is None:
-                ttl = DEFAULT_TTL
-            if type(ttl) is not int:
-                return False           # store garbage: generic path
-            body = (b"\xc0\x0c\x00\x01\x00\x01"
-                    + struct.pack(">IH", ttl & 0xFFFFFFFF, 4) + packed)
-            ancount = 1
+                else:
+                    record = node.data if type(node.data) is dict else {}
+                    rt = record.get("type")
+                    sub = record.get(rt) if type(rt) is str else None
+                    ttl = record.get("ttl")
+                    sttl = sub.get("ttl") if type(sub) is dict else None
+                    if sttl is not None:
+                        ttl = sttl
+                    elif ttl is None:
+                        ttl = DEFAULT_TTL
+                    if type(ttl) is not int:
+                        return False   # store garbage: generic path
+                    target = node.domain
+                    if target.endswith(".arpa"):
+                        # the generic encoder could compress the target
+                        # against the reverse qname; keep parity by
+                        # declining the (absurd) overlap case
+                        return False
+                    # the one real name encoder enforces the label and
+                    # 255-byte total bounds the generic path would
+                    # SERVFAIL on; unencodable targets decline
+                    tw = self._qname_wire(target)
+                    if tw is None:
+                        return False
+                    body = (b"\xc0\x0c\x00\x0c\x00\x01"
+                            + struct.pack(">IH", ttl & 0xFFFFFFFF,
+                                          len(tw)) + tw)
+                    ancount = 1
+                    ans = [{"type": "PTR", "name": name, "ttl": ttl,
+                            "target": target}]
 
         flags_out = 0x8400 | (0x0100 if rd_flag else 0) | rcode
         wire = (data[:2]
@@ -549,12 +612,14 @@ class BinderServer:
                               1 if edns else 0)
                 + data[12:q_end] + body
                 + (_OPT_ECHO_WIRE if edns else b""))
+        if udp_sem and len(wire) > payload:
+            # a long reverse qname + long target can exceed the UDP
+            # ceiling; the generic path owns truncation semantics
+            return False
         send(wire)
         try:
-            ans = ([f"{strip_suffix(dd_suffix, name)} A {addr}"]
-                   if ancount else [])
             self._lane_finish(data, src, protocol, start, wire, rcode,
-                              edns, ans, [])
+                              edns, ans, [], qtype=qtype_val)
             if rcode != Rcode.SERVFAIL:
                 # cache entries carry a lowercased question so hits can
                 # splice in each requester's own case (and so generic
@@ -573,10 +638,12 @@ class BinderServer:
                         and self._fastpath_active()):
                     qname_low = data[12:q_end - 4].lower()
                     ckey = _fastpath_key_parts(
-                        bool(rd_flag), edns, payload, 1, 1, qname_low)
+                        bool(rd_flag), edns, payload, qtype_val, 1,
+                        qname_low)
                     try:
                         _fastio.fastpath_put(
-                            self._fastpath, ckey, 1, epoch, [cache_wire],
+                            self._fastpath, ckey, qtype_val, epoch,
+                            [cache_wire],
                             int(self.answer_cache.expiry_s * 1000),
                             qname_low)
                     except (TypeError, ValueError, MemoryError) as e:
@@ -589,11 +656,11 @@ class BinderServer:
 
     def _lane_finish(self, data, src, protocol: str, start: float,
                      wire: bytes, rcode: int, edns: bool, ans, add,
-                     cached: bool = False) -> None:
+                     qtype: int = 1, cached: bool = False) -> None:
         """Metrics + the slow-query warn for a lane-handled query
         (the lane equivalent of _on_after with queryLog off)."""
         lat_s = time.monotonic() - start
-        ch = self._children_for(1)
+        ch = self._children_for(qtype)
         ch[0].inc()
         ch[1].observe(lat_s)
         ch[2].observe(len(wire))

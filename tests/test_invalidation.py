@@ -210,6 +210,87 @@ class TestNativeTagInvalidation:
 
 
 class TestDifferentialChurn:
+    def test_service_and_ptr_churn_never_serves_stale(self):
+        """Service-shaped churn: load_balancer children come and go under
+        a service node while SRV, rotated A, and PTR queries interleave —
+        every answer must reflect current children/addresses (the
+        parent-tag and reverse-tag emission paths)."""
+        async def run():
+            store, cache, server = build()
+            await server.start()
+            rng = random.Random(11)
+            members = {}     # child name -> address (under svc.foo.com)
+            next_id = [100]
+            next_addr = [0]
+
+            def fresh_addr():
+                # unique addresses: the reverse index is last-writer-wins
+                # on duplicates, which is not what this test probes
+                next_addr[0] += 1
+                return f"10.6.{next_addr[0] >> 8}.{next_addr[0] & 255}"
+            try:
+                for i in range(4):   # the fixture's lb0..lb3
+                    members[f"lb{i}"] = f"10.0.1.{i + 1}"
+                for step in range(200):
+                    op = rng.random()
+                    if op < 0.2 and len(members) < 12:
+                        name = f"m{next_id[0]}"
+                        next_id[0] += 1
+                        addr = fresh_addr()
+                        store.put_json(
+                            f"/com/foo/svc/{name}",
+                            {"type": "load_balancer",
+                             "load_balancer": {"address": addr}})
+                        members[name] = addr
+                    elif op < 0.35 and len(members) > 1:
+                        victim = rng.choice(sorted(members))
+                        removed_addr = members.pop(victim)
+                        store.delete(f"/com/foo/svc/{victim}")
+                        # the just-removed address must stop resolving
+                        # (unbind's reverse-tag emission)
+                        rev = ".".join(reversed(removed_addr.split("."))) \
+                            + ".in-addr.arpa"
+                        r = await udp_ask(server.udp_port, rev, Type.PTR,
+                                          (step * 7 + 5) % 65536)
+                        assert r.rcode == Rcode.REFUSED, \
+                            f"step {step}: stale PTR for {removed_addr}"
+                    elif op < 0.5 and members:
+                        victim = rng.choice(sorted(members))
+                        addr = fresh_addr()
+                        store.put_json(
+                            f"/com/foo/svc/{victim}",
+                            {"type": "load_balancer",
+                             "load_balancer": {"address": addr}})
+                        members[victim] = addr
+
+                    want = sorted(members.values())
+                    # rotated A answers over the full member set
+                    r = await udp_ask(server.udp_port, "svc.foo.com",
+                                      Type.A, step * 3 % 65536)
+                    got = sorted(a.address for a in r.answers)
+                    assert got == want, f"step {step}: A {got} != {want}"
+                    # SRV answers carry every member as a target port
+                    r = await udp_ask(server.udp_port,
+                                      "_pg._tcp.svc.foo.com", Type.SRV,
+                                      (step * 3 + 1) % 65536)
+                    assert len(r.answers) == len(members), \
+                        f"step {step}: SRV {len(r.answers)}"
+                    # PTR for one current member resolves; a just-removed
+                    # address must not
+                    if members:
+                        addr = rng.choice(sorted(members.values()))
+                        rev = ".".join(reversed(addr.split("."))) \
+                            + ".in-addr.arpa"
+                        r = await udp_ask(server.udp_port, rev,
+                                          Type.PTR,
+                                          (step * 3 + 2) % 65536)
+                        assert r.rcode == Rcode.NOERROR, \
+                            f"step {step}: PTR {addr} -> {r.rcode}"
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
     def test_random_churn_never_serves_stale(self):
         """Randomized soak: interleave mutations and queries; every
         answer must reflect the store state at query time (the fake

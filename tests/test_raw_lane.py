@@ -100,7 +100,15 @@ QUERY_SHAPES = [
     ("db.foo.com", Type.A, False, 1232),         # database type (declined)
     ("svc.foo.com", Type.A, False, 1232),        # service A (declined)
     ("_pg._tcp.svc.foo.com", Type.SRV, False, 1232),   # SRV (declined)
-    ("1.0.168.192.in-addr.arpa", Type.PTR, False, 1232),  # PTR (declined)
+    ("1.0.168.192.in-addr.arpa", Type.PTR, False, 1232),  # PTR hit
+    ("1.0.168.192.in-addr.arpa", Type.PTR, False, None),  # PTR, no EDNS
+    ("1.0.168.192.in-addr.arpa", Type.PTR, True, 1232),   # PTR, RD set
+    ("2.0.0.10.in-addr.arpa", Type.PTR, False, 1232),  # PTR sub-TTL wins
+    ("9.9.9.9.in-addr.arpa", Type.PTR, False, 1232),   # PTR miss REFUSED
+    ("web.foo.com", Type.PTR, False, 1232),      # not a reverse name
+    ("1.2.3.4.ip6.arpa", Type.PTR, False, 1232),  # v6 reverse REFUSED
+    ("5.1.0.168.192.in-addr.arpa", Type.PTR, False, 1232),  # 5 octets
+    ("192.in-addr.arpa", Type.PTR, False, 1232),  # partial reverse
     ("web.foo.com", Type.AAAA, False, 1232),     # unsupported qtype
 ]
 
