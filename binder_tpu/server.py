@@ -27,6 +27,7 @@ from binder_tpu.dns.wire import (
     MAX_UDP_PAYLOAD,
     ARecord,
     OPTRecord,
+    PTRRecord,
     Rcode,
     SRVRecord,
     Type,
@@ -78,6 +79,21 @@ _LANE_HOST_TYPES = frozenset({
 })
 
 
+def _lane_ttl(record: dict, sub) -> Optional[int]:
+    """Deepest-object-wins TTL (the one policy, engine._record_ttl:
+    sub-record TTL wins, else record TTL, else default); None means the
+    store value is garbage and the lane must decline to the generic
+    path.  Shared by the A and PTR lane branches so the precedence
+    cannot drift between them."""
+    ttl = record.get("ttl")
+    sttl = sub.get("ttl") if type(sub) is dict else None
+    if sttl is not None:
+        ttl = sttl
+    elif ttl is None:
+        ttl = DEFAULT_TTL
+    return ttl if type(ttl) is int else None
+
+
 def _fastpath_key_parts(rd: bool, edns: bool, payload: int, qtype: int,
                         qclass: int, qname_wire: bytes) -> bytes:
     """The native answer-cache key, from its components.
@@ -127,10 +143,12 @@ class BinderServer:
         self.cache_hit_counter = self.collector.counter(
             "binder_answer_cache_hits", "encoded-answer cache hits")
         self._cache_hit_child = self.cache_hit_counter.labelled()
+        self._fp_inval_total = 0   # C-side drops, updated at each fold
         self.collector.gauge(
             "binder_answer_cache_invalidations",
             "answer-cache entries dropped by per-name store invalidation"
-        ).set_function(lambda: float(self.answer_cache.invalidations))
+        ).set_function(lambda: float(self.answer_cache.invalidations
+                                     + self._fp_inval_total))
 
         self.request_counter = self.collector.counter(
             METRIC_REQUEST_COUNTER, "count of Binder requests completed")
@@ -541,13 +559,8 @@ class BinderServer:
                     return False       # generic path SERVFAILs
                 if _socket.inet_ntoa(packed) != addr:
                     return False       # non-canonical dotted quad
-                ttl = record.get("ttl")
-                sttl = sub.get("ttl")
-                if sttl is not None:
-                    ttl = sttl
-                elif ttl is None:
-                    ttl = DEFAULT_TTL
-                if type(ttl) is not int:
+                ttl = _lane_ttl(record, sub)
+                if ttl is None:
                     return False       # store garbage: generic path
                 body = (b"\xc0\x0c\x00\x01\x00\x01"
                         + struct.pack(">IH", ttl & 0xFFFFFFFF, 4)
@@ -579,13 +592,8 @@ class BinderServer:
                     record = node.data if type(node.data) is dict else {}
                     rt = record.get("type")
                     sub = record.get(rt) if type(rt) is str else None
-                    ttl = record.get("ttl")
-                    sttl = sub.get("ttl") if type(sub) is dict else None
-                    if sttl is not None:
-                        ttl = sttl
-                    elif ttl is None:
-                        ttl = DEFAULT_TTL
-                    if type(ttl) is not int:
+                    ttl = _lane_ttl(record, sub)
+                    if ttl is None:
                         return False   # store garbage: generic path
                     target = node.domain
                     if target.endswith(".arpa"):
@@ -603,8 +611,10 @@ class BinderServer:
                             + struct.pack(">IH", ttl & 0xFFFFFFFF,
                                           len(tw)) + tw)
                     ancount = 1
-                    ans = [{"type": "PTR", "name": name, "ttl": ttl,
-                            "target": target}]
+                    # through _summarize so the log shape cannot drift
+                    # from what the generic path records
+                    ans = [self._summarize(
+                        PTRRecord(name=name, ttl=ttl, target=target))]
 
         flags_out = 0x8400 | (0x0100 if rd_flag else 0) | rcode
         wire = (data[:2]
@@ -697,6 +707,7 @@ class BinderServer:
             if hits_delta > 0:
                 self._cache_hit_child.inc(hits_delta)
             last["hits"] = stats["hits"]
+            self._fp_inval_total = stats.get("invalidations", 0)
             for qtype, s in stats["per_qtype"].items():
                 children = self._children_for(qtype)
                 prev = last.get(qtype)

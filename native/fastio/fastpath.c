@@ -456,11 +456,12 @@ fastpath_stats(PyObject *self, PyObject *args)
         }
     }
     return Py_BuildValue(
-        "{s:K,s:K,s:I,s:K,s:N}",
+        "{s:K,s:K,s:I,s:K,s:K,s:N}",
         "hits", (unsigned long long)c->hits,
         "lookups", (unsigned long long)c->lookups,
         "entries", (unsigned)c->n_entries,
         "bytes", (unsigned long long)c->total_bytes,
+        "invalidations", (unsigned long long)c->invalidations,
         "per_qtype", per);
 }
 

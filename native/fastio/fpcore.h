@@ -78,6 +78,7 @@ typedef struct {
     int n_qstats;
     uint64_t hits;
     uint64_t lookups;
+    uint64_t invalidations;   /* entries dropped by fp_invalidate_tag */
 } fp_cache_t;
 
 static inline double
@@ -303,6 +304,7 @@ fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
             n++;
         }
     }
+    c->invalidations += n;
     return n;
 }
 
