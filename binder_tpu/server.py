@@ -566,7 +566,10 @@ class BinderServer:
                         + struct.pack(">IH", ttl & 0xFFFFFFFF, 4)
                         + packed)
                 ancount = 1
-                ans = [f"{strip_suffix(dd_suffix, name)} A {addr}"]
+                # through _summarize so the log shape cannot drift from
+                # what the generic path records
+                ans = [self._summarize(
+                    ARecord(name=name, ttl=ttl, address=addr))]
         else:
             # PTR: mirrors Resolver.resolve_ptr exactly — note there is
             # NO dnsDomain suffix policy on the reverse tree

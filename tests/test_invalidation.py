@@ -206,7 +206,11 @@ class TestNativeTagInvalidation:
         assert fastio.fastpath_stats(cap)["entries"] == 1
         # right tag
         assert fastio.fastpath_invalidate(cap, qname) == 1
-        assert fastio.fastpath_stats(cap)["entries"] == 0
+        stats = fastio.fastpath_stats(cap)
+        assert stats["entries"] == 0
+        # the monotonic drop counter feeds the server's
+        # binder_answer_cache_invalidations gauge (absolute, not delta)
+        assert stats["invalidations"] == 1
 
 
 class TestDifferentialChurn:
