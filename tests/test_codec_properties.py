@@ -1,0 +1,148 @@
+"""Property-based tests for the other two hand-rolled codecs:
+
+- jute (binder_tpu/store/jute.py) — the ZooKeeper wire primitives the
+  client, test server, and zlogcat all build on;
+- BER (binder_tpu/recursion/ber.py) — the LDAPv3 substrate that parses
+  untrusted directory responses in the UFDS client.
+
+Same contract style as test_wire_properties.py: round-trips hold over
+the whole representable space, and decoding arbitrary bytes only ever
+raises the codec's own error type.
+"""
+import struct
+
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from binder_tpu.recursion import ber
+from binder_tpu.store import jute
+from binder_tpu.store.jute import Buf
+
+i32s = st.integers(min_value=-2**31, max_value=2**31 - 1)
+i64s = st.integers(min_value=-2**63, max_value=2**63 - 1)
+blobs = st.binary(max_size=200)
+texts = st.text(max_size=100)
+
+
+class TestJute:
+    @settings(max_examples=300, deadline=None)
+    @given(i32s, i64s, st.booleans(), blobs, texts)
+    def test_primitive_round_trip(self, a, b, flag, blob, s):
+        wire = (jute.i32(a) + jute.i64(b) + jute.boolean(flag)
+                + jute.buffer(blob) + jute.string(s))
+        buf = Buf(wire)
+        assert buf.i32() == a
+        assert buf.i64() == b
+        assert buf.boolean() == flag
+        assert buf.buffer() == blob
+        assert buf.string() == s
+
+    @settings(max_examples=200, deadline=None)
+    @given(blobs)
+    def test_frame_is_length_prefixed(self, payload):
+        f = jute.frame(payload)
+        (length,) = struct.unpack(">i", f[:4])
+        assert length == len(payload)
+        assert f[4:] == payload
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.integers(0, 2**31 - 1), st.integers(0, 2**31 - 1),
+           st.integers(0, 1000), st.integers(0, 1000))
+    def test_stat_round_trip(self, czxid, mzxid, version, cversion):
+        wire = jute.pack_stat(czxid=czxid, mzxid=mzxid, version=version,
+                              cversion=cversion)
+        stat = jute.read_stat(Buf(wire))
+        assert stat["czxid"] == czxid
+        assert stat["version"] == version
+        assert stat["cversion"] == cversion
+
+    @settings(max_examples=500, deadline=None)
+    @given(st.binary(max_size=64))
+    def test_buf_reads_never_raise_anything_else(self, data):
+        """Truncated/garbage buffers raise the Buf's own error type
+        (whatever the reads use — ValueError/IndexError/struct.error are
+        all caught by the client's session loop), never hang."""
+        for read in ("i32", "i64", "boolean", "buffer", "string"):
+            buf = Buf(data)
+            try:
+                getattr(buf, read)()
+            except Exception as e:  # noqa: BLE001 — asserting the type set
+                assert isinstance(
+                    e, (ValueError, IndexError, struct.error)), e
+
+
+ber_values = st.recursive(
+    st.one_of(
+        st.tuples(st.just("int"), st.integers(-2**31, 2**31 - 1)),
+        st.tuples(st.just("str"), st.text(max_size=50)),
+        st.tuples(st.just("bool"), st.booleans()),
+    ),
+    lambda children: st.tuples(st.just("seq"),
+                               st.lists(children, max_size=4)),
+    max_leaves=10,
+)
+
+
+def ber_encode(value):
+    kind, v = value
+    if kind == "int":
+        return ber.encode_int(v)
+    if kind == "str":
+        return ber.encode_str(v)
+    if kind == "bool":
+        return ber.encode_bool(v)
+    return ber.encode_seq([ber_encode(x) for x in v])
+
+
+def ber_check(value, tag, content):
+    kind, v = value
+    if kind == "int":
+        assert tag == ber.INTEGER
+        assert ber.decode_int(content) == v
+    elif kind == "str":
+        assert tag == ber.OCTET_STRING
+        assert content == v.encode("utf-8")
+    elif kind == "bool":
+        assert tag == ber.BOOLEAN
+        assert content == (b"\xff" if v else b"\x00")   # DER canonical
+    else:
+        assert tag == ber.SEQUENCE
+        parts = ber.decode_all(content)
+        assert len(parts) == len(v)
+        for sub, (stag, scontent) in zip(v, parts):
+            ber_check(sub, stag, scontent)
+
+
+class TestBer:
+    @settings(max_examples=300, deadline=None)
+    @given(ber_values)
+    def test_round_trip(self, value):
+        wire = ber_encode(value)
+        tag, content, end = ber.decode_tlv(wire)
+        assert end == len(wire)
+        ber_check(value, tag, content)
+
+    @settings(max_examples=1000, deadline=None)
+    @given(st.binary(max_size=300))
+    def test_decode_never_raises_anything_but_bererror(self, data):
+        try:
+            ber.decode_tlv(data)
+        except ber.BerError:
+            pass
+        try:
+            ber.decode_all(data)
+        except ber.BerError:
+            pass
+        try:
+            ber.frame_length(data)
+        except ber.BerError:
+            pass
+
+    @settings(max_examples=200, deadline=None)
+    @given(ber_values)
+    def test_frame_length_matches_encoding(self, value):
+        wire = ber_encode(value)
+        assert ber.frame_length(wire) == len(wire)
