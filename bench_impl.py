@@ -234,10 +234,10 @@ async def _drive(port: int) -> Dict[str, float]:
 DNSBLAST = os.path.join(ROOT, "native", "build", "dnsblast")
 
 
-def _write_templates(path: str, mix) -> None:
+def _write_templates(path: str, mix, rd: bool = False) -> None:
     with open(path, "wb") as f:
         for name, qtype in mix:
-            wire = make_query(name, qtype, qid=0).encode()
+            wire = make_query(name, qtype, qid=0, rd=rd).encode()
             f.write(len(wire).to_bytes(2, "big") + wire)
 
 
@@ -314,6 +314,32 @@ N_CHURN_HOSTS = 64            # hosts the churner rewrites round-robin
 CHURN_INTERVAL_S = 0.002      # ~500 mutations/s offered
 
 
+def _wait_ready(port: int, probe: bytes, what: str,
+                deadline_s: float = 15.0) -> None:
+    """Poll the server with one probe query until it answers NOERROR —
+    the first queries SERVFAIL (or time out) until the mirror / the
+    recursion path is actually serving."""
+    import socket as _s
+    s = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+    s.settimeout(0.5)
+    s.connect(("127.0.0.1", port))
+    deadline = time.time() + deadline_s
+    try:
+        while True:
+            try:
+                s.send(probe)
+                resp = s.recv(512)
+                if not (resp[3] & 0x0F):
+                    return
+            except _s.timeout:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError(f"{what} never became ready")
+            time.sleep(0.1)
+    finally:
+        s.close()
+
+
 async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
     from binder_tpu.store.zk_client import ZKClient
 
@@ -366,25 +392,11 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
         port = wait_for_port(srv_proc)
 
         # wait until the mirror actually serves (first queries SERVFAIL
-        # until the watch tree is built)
-        probe = make_query(*BENCH_MIX[0], qid=1).encode()
-        import socket as _s
-        s = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
-        s.settimeout(0.5)
-        s.connect(("127.0.0.1", port))
-        deadline = time.time() + 15
-        while True:
-            try:
-                s.send(probe)
-                resp = s.recv(512)
-                if not (resp[3] & 0x0F):
-                    break
-            except _s.timeout:
-                pass
-            if time.time() > deadline:
-                raise RuntimeError("server never became ready over zk")
-            await asyncio.sleep(0.1)
-        s.close()
+        # until the watch tree is built); blocking is fine — the churner
+        # does not exist yet
+        await asyncio.to_thread(
+            _wait_ready, port, make_query(*BENCH_MIX[0], qid=1).encode(),
+            "server over zk")
 
         tmpl = os.path.join(tmpdir, "churn_queries.bin")
         _write_templates(tmpl, BENCH_MIX)
@@ -499,6 +511,68 @@ def _bench_churn(tmpdir: str) -> Dict[str, float]:
 MBALANCER = os.path.join(ROOT, "native", "build", "mbalancer")
 
 
+N_RECURSION = int(os.environ.get("BENCH_RECURSION_QUERIES", "5000"))
+
+
+def _bench_recursion(tmpdir: str) -> Dict[str, float]:
+    """Cross-DC forwarding axis (BASELINE.json proxy config 'recursive
+    resolution'): every query misses the local mirror with RD=1 and is
+    forwarded to a remote-DC binder on 127.0.0.2 (the self-NIC filter
+    covers 127.0.0.1), with answers rebuilt per query and never cached
+    (recursion responses carry the do-not-store marker)."""
+    remote_fix = {f"/com/bench/remotedc/w{i}": {
+        "type": "host", "host": {"address": f"10.20.0.{i + 1}"}}
+        for i in range(64)}
+    remote_fixture = os.path.join(tmpdir, "remote_fixture.json")
+    with open(remote_fixture, "w") as f:
+        json.dump(remote_fix, f)
+    remote_config = os.path.join(tmpdir, "remote_config.json")
+    with open(remote_config, "w") as f:
+        json.dump({"dnsDomain": "bench.com",
+                   "datacenterName": "remotedc", "host": "127.0.0.2",
+                   "store": {"backend": "fake",
+                             "fixture": remote_fixture},
+                   "queryLog": False}, f)
+
+    local_fixture = os.path.join(tmpdir, "local_empty.json")
+    with open(local_fixture, "w") as f:
+        json.dump({}, f)
+
+    remote = local = None
+    try:
+        remote = _launch_server(remote_config)
+        rport = wait_for_port(remote)
+        local_config = os.path.join(tmpdir, "local_rec_config.json")
+        with open(local_config, "w") as f:
+            json.dump({"dnsDomain": "bench.com",
+                       "datacenterName": "local", "host": "127.0.0.1",
+                       "store": {"backend": "fake",
+                                 "fixture": local_fixture},
+                       "queryLog": False,
+                       "recursion": {
+                           "dcs": {"remotedc":
+                                   [f"127.0.0.2:{rport}"]}}}, f)
+        local = _launch_server(local_config)
+        port = wait_for_port(local)
+
+        tmpl = os.path.join(tmpdir, "rec_queries.bin")
+        _write_templates(
+            tmpl, [(f"w{i}.remotedc.bench.com", Type.A)
+                   for i in range(64)], rd=True)
+
+        # readiness probe: forwarding works end to end before timing
+        _wait_ready(port, make_query("w0.remotedc.bench.com", Type.A,
+                                     qid=1, rd=True).encode(),
+                    "recursion path")
+
+        return _drive_native(port, tmpdir, tmpl_path=tmpl,
+                             n=N_RECURSION)
+    finally:
+        for p in (local, remote):
+            if p is not None:
+                _reap(p)
+
+
 def _launch_balancer(sockdir: str):
     """Start mbalancer on an ephemeral port fronting `sockdir`; returns
     (proc, port).  Shared by the topology and balancer-churn axes so
@@ -553,7 +627,7 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
 
 
 def run_bench() -> Dict[str, object]:
-    topo = miss = churn = None
+    topo = miss = churn = recur = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -579,6 +653,12 @@ def run_bench() -> Dict[str, object]:
             except Exception as e:
                 print(f"bench: churn axis failed: {e!r}", file=sys.stderr)
                 churn = None
+            try:
+                recur = _bench_recursion(tmpdir)
+            except Exception as e:
+                print(f"bench: recursion axis failed: {e!r}",
+                      file=sys.stderr)
+                recur = None
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             try:
                 topo = _bench_topology(tmpdir)
@@ -659,6 +739,12 @@ def run_bench() -> Dict[str, object]:
             # invalidation keeps its cache hot for unmutated names)
             out["churn_topology_qps"] = round(churn["topo_qps"], 1)
             out["churn_topology_p99_us"] = round(churn["topo_p99_us"], 1)
+    if recur is not None:
+        # cross-DC forwarding (BASELINE.json proxy config 'recursive
+        # resolution'): per-query upstream round trip, never cached
+        out["recursion_qps"] = round(recur["qps"], 1)
+        out["recursion_p50_us"] = round(recur["p50_us"], 1)
+        out["recursion_p99_us"] = round(recur["p99_us"], 1)
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm
         out["topology_qps"] = round(topo["qps"], 1)
