@@ -133,6 +133,39 @@ class QueryCtx:
                 or (self.protocol == "balancer"
                     and self.client_transport != "tcp"))
 
+    def _echo_question_case(self, wire: bytes) -> bytes:
+        """dns0x20 (draft-vixie-dnsext-dns0x20): echo the requester's
+        original question bytes — the encoder emits lowercase, but 0x20
+        validators (including our own upstream DNS client) require the
+        exact case mask back.  Declines (returns the wire unchanged) for
+        any shape it can't prove safe: no raw request, multi-question,
+        compressed qname, or a question that differs beyond case."""
+        raw = self.raw
+        if raw is None or len(raw) < 17 or raw[4:6] != b"\x00\x01" \
+                or wire[4:6] != b"\x00\x01":
+            return wire
+        off = 12
+        try:
+            while True:
+                ll = raw[off]
+                if ll == 0:
+                    off += 1
+                    break
+                if ll & 0xC0:
+                    return wire          # compressed qname in request
+                off += 1 + ll
+        except IndexError:
+            return wire
+        q_end = off + 4
+        if q_end > len(raw) or q_end > len(wire):
+            return wire
+        req_q = raw[12:q_end]
+        if wire[12:q_end] == req_q:
+            return wire                  # already identical
+        if wire[12:q_end].lower() != req_q.lower():
+            return wire                  # different question: leave it
+        return wire[:12] + req_q + wire[q_end:]
+
     def respond(self) -> None:
         if self._responded:
             return
@@ -142,6 +175,7 @@ class QueryCtx:
             wire = self.response.encode(max_size=self.request.max_udp_payload())
         else:
             wire = self.response.encode()
+        wire = self._echo_question_case(wire)
         self._responded = True
         self.wire = wire
         self.bytes_sent = len(wire)
@@ -149,10 +183,11 @@ class QueryCtx:
 
     def respond_raw(self, wire: bytes) -> None:
         """Send a pre-encoded response (answer-cache hit), patching in
-        this request's id."""
+        this request's id and the requester's question case."""
         if self._responded:
             return
         wire = self.request.id.to_bytes(2, "big") + wire[2:]
+        wire = self._echo_question_case(wire)
         self._responded = True
         self.wire = wire
         self.bytes_sent = len(wire)

@@ -30,8 +30,95 @@ def _parse_resolver(r: str) -> Tuple[str, int]:
     return parse_endpoint(r, 53)
 
 
+class _PortProto(asyncio.DatagramProtocol):
+    """Shared connected-UDP endpoint for one upstream, id-multiplexed:
+    qid -> (future, expected question bytes).
+
+    Sharing a socket fixes the local port for the client's lifetime,
+    which on its own would cut blind-spoofing entropy to the 16-bit id
+    (the connected-socket peer filter does not stop packets forged with
+    the resolver's source address).  The lost entropy is restored with
+    dns0x20 (draft-vixie-dnsext-dns0x20): every query's qname gets a
+    random case mask, and a response only counts if it echoes the
+    question section byte-for-byte — anything else is dropped silently
+    and the real answer keeps being awaited."""
+
+    def __init__(self) -> None:
+        self.pending: dict = {}
+        self.transport = None
+        self.case_mismatch_drops = 0
+        self.log = logging.getLogger("binder.dnsclient")
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def datagram_received(self, data, addr) -> None:
+        if len(data) < 12:
+            return
+        entry = self.pending.get((data[0] << 8) | data[1])
+        if entry is None:
+            return                      # late/duplicate response
+        fut, expect_q = entry
+        if fut.done():
+            return
+        # verbatim question echo (id + 0x20 case mask) or it's not ours
+        if data[12:12 + len(expect_q)] != expect_q:
+            # either a spoof attempt or an 0x20-incompatible upstream
+            # (one that case-normalizes the echoed question): surface it,
+            # rate-limited, or every lookup is an undiagnosable timeout
+            self.case_mismatch_drops += 1
+            n = self.case_mismatch_drops
+            if n & (n - 1) == 0:        # 1, 2, 4, 8, ...
+                self.log.warning(
+                    "dropping upstream response with mismatched question "
+                    "echo (dns0x20); %d dropped on this socket so far "
+                    "(0x20-incompatible upstream, or spoofed traffic)", n)
+            return
+        del self.pending[(data[0] << 8) | data[1]]
+        try:
+            msg = Message.decode(data)
+        except Exception as e:  # noqa: BLE001 — malformed upstream bytes
+            fut.set_exception(WireTimeout(f"bad upstream response: {e}"))
+            return
+        fut.set_result(msg)
+
+    def _fail_all(self, exc) -> None:
+        for fut, _q in self.pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self.pending.clear()
+
+    def error_received(self, exc) -> None:
+        # ICMP errors carry no query attribution on a connected socket;
+        # everything in flight to this upstream is dead
+        self._fail_all(exc)
+
+    def connection_lost(self, exc) -> None:
+        self._fail_all(exc or ConnectionError("upstream socket closed"))
+
+
+def _close_transport(proto: "_PortProto") -> None:
+    """Close a pooled transport; if its event loop is already gone,
+    release the underlying socket fd directly."""
+    if proto.transport is None:
+        return
+    try:
+        proto.transport.close()
+    except Exception:  # noqa: BLE001 — owning loop closed
+        sock = proto.transport.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class DnsClient:
-    """Queries a set of upstream resolvers with bounded concurrency."""
+    """Queries a set of upstream resolvers with bounded concurrency.
+
+    One connected UDP socket is kept per upstream and shared by every
+    in-flight query (id-multiplexed) — per-query socket creation would
+    dominate the forwarding path's cost and churn ephemeral ports."""
 
     def __init__(self, concurrency: int = 2,
                  timeout: float = DEFAULT_TIMEOUT,
@@ -39,6 +126,50 @@ class DnsClient:
         self.concurrency = concurrency
         self.timeout = timeout
         self.log = log or logging.getLogger("binder.dnsclient")
+        # (host, port) -> (loop, _PortProto); recreated if the transport
+        # died or the entry belongs to a previous event loop (tests run
+        # several loops in one process)
+        self._ports: dict = {}
+
+    async def _get_port(self, host: str, port: int) -> _PortProto:
+        loop = asyncio.get_running_loop()
+        entry = self._ports.get((host, port))
+        if entry is not None:
+            e_loop, proto = entry
+            if (e_loop is loop and proto.transport is not None
+                    and not proto.transport.is_closing()):
+                return proto
+            _close_transport(proto)     # dead or from a previous loop
+            self._ports.pop((host, port), None)
+        transport, proto = await loop.create_datagram_endpoint(
+            _PortProto, remote_addr=(host, port))
+        # a concurrent first query may have created the port while we
+        # awaited; keep the stored one and release ours, or every
+        # 100-way PTR fan-out would leak sockets
+        entry = self._ports.get((host, port))
+        if entry is not None and entry[0] is loop \
+                and entry[1].transport is not None \
+                and not entry[1].transport.is_closing():
+            transport.close()
+            return entry[1]
+        self._ports[(host, port)] = (loop, proto)
+        return proto
+
+    def close(self) -> None:
+        for (_e_loop, proto) in self._ports.values():
+            _close_transport(proto)
+        self._ports.clear()
+
+    def prune(self, keep: "set") -> None:
+        """Close pooled sockets for upstreams no longer in the resolver
+        set (long-lived processes see resolver churn; without pruning,
+        one fd per address ever seen accumulates).  In-flight sockets
+        are kept — the next prune after they drain gets them."""
+        for key in list(self._ports):
+            _e_loop, proto = self._ports[key]
+            if key not in keep and not proto.pending:
+                _close_transport(proto)
+                del self._ports[key]
 
     async def lookup(self, name: str, qtype: int,
                      resolvers: Sequence[str],
@@ -118,39 +249,39 @@ class DnsClient:
     async def _query_one(self, name: str, qtype: int,
                          resolver: str) -> Message:
         host, port = _parse_resolver(resolver)
+        proto = await self._get_port(host, port)
         loop = asyncio.get_running_loop()
-        fut: asyncio.Future = loop.create_future()
+        # qid must be unique among this upstream's in-flight queries
         qid = random.randrange(0, 65536)
+        while qid in proto.pending:
+            qid = random.randrange(0, 65536)
         # Forwarded queries must not re-recurse: clear RD
         # (lib/recursion.js:259-261)
         query = make_query(name, qtype, qid=qid, rd=False)
-
-        class Proto(asyncio.DatagramProtocol):
-            def connection_made(self, transport):
-                self.transport = transport
-                transport.sendto(query.encode())
-
-            def datagram_received(self, data, addr):
-                try:
-                    msg = Message.decode(data)
-                except Exception as e:  # noqa: BLE001
-                    if not fut.done():
-                        fut.set_exception(
-                            WireTimeout(f"bad upstream response: {e}"))
-                    return
-                if msg.id == qid and not fut.done():
-                    fut.set_result(msg)
-
-            def error_received(self, exc):
-                if not fut.done():
-                    fut.set_exception(exc)
-
-        transport, _ = await loop.create_datagram_endpoint(
-            Proto, remote_addr=(host, port))
+        wire = bytearray(query.encode())
+        # dns0x20: random case mask over the qname's alpha bytes (the
+        # encoder emits lowercase; a fresh query's qname sits at offset
+        # 12, uncompressed); the response must echo these exact bytes
+        off = 12
+        while wire[off] != 0:
+            ll = wire[off]
+            for i in range(off + 1, off + 1 + ll):
+                if 0x61 <= wire[i] <= 0x7A and random.getrandbits(1):
+                    wire[i] -= 0x20
+            off += 1 + ll
+        expect_q = bytes(wire[12:off + 5])   # qname + terminator + type/class
+        fut: asyncio.Future = loop.create_future()
+        proto.pending[qid] = (fut, expect_q)
         try:
+            proto.transport.sendto(bytes(wire))
             return await asyncio.wait_for(fut, self.timeout)
         finally:
-            transport.close()
+            # pop only our own entry: after this qid was released (answer
+            # delivered / socket failed), another query may have re-used
+            # it before this finally ran
+            cur = proto.pending.get(qid)
+            if cur is not None and cur[0] is fut:
+                del proto.pending[qid]
 
     async def _query_one_tcp(self, name: str, qtype: int,
                              resolver: str) -> Message:

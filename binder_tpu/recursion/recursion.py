@@ -147,6 +147,8 @@ class Recursion:
         for t in self._bg:
             t.cancel()
         await asyncio.gather(*self._bg, return_exceptions=True)
+        self.nsc.close()
+        self.nsc_max.close()
         closer = getattr(self.source, "close", None)
         if closer is not None:
             await closer()
@@ -190,6 +192,13 @@ class Recursion:
                 ips.append(r["ip"])
         self.log.debug("Recursion: setting recursion resolvers: %r", dcs)
         self.dcs = dcs
+        # drop pooled upstream sockets for resolvers that left the set
+        # (long-lived processes see resolver churn)
+        from binder_tpu.recursion.client import _parse_resolver
+        keep = {_parse_resolver(ip)
+                for ips in dcs.values() for ip in ips}
+        self.nsc.prune(keep)
+        self.nsc_max.prune(keep)
 
     # -- the resolve path (lib/recursion.js:287-388) --
 

@@ -408,9 +408,9 @@ class BinderServer:
         query-log/probes active — returns False and takes the generic
         path, so divergence is impossible for declined shapes.
 
-        One deliberate improvement over the generic path: the question
-        section is echoed with the requester's original case (dns0x20
-        compatible), where the generic encoder re-emits it lowercased.
+        The question section is echoed with the requester's original
+        case (dns0x20), matching the generic path's echo in
+        QueryCtx._echo_question_case.
         """
         if (self.query_log or self.p_req_start.enabled
                 or self.p_req_done.enabled):
@@ -635,8 +635,8 @@ class BinderServer:
                               edns, ans, [], qtype=qtype_val)
             if rcode != Rcode.SERVFAIL:
                 # cache entries carry a lowercased question so hits can
-                # splice in each requester's own case (and so generic
-                # respond_raw hits keep today's lowercase echo)
+                # splice in each requester's own case (generic hits do
+                # the same via QueryCtx._echo_question_case)
                 q_sec = data[12:q_end]
                 q_low = q_sec.lower()
                 cache_wire = (wire if q_sec == q_low

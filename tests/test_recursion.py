@@ -8,7 +8,7 @@ matrix it leaves untested.
 import asyncio
 
 
-from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.dns import ARecord, Message, Rcode, Type, make_query
 from binder_tpu.metrics.collector import MetricsCollector
 from binder_tpu.recursion import Recursion, StaticResolverSource
 from binder_tpu.server import BinderServer
@@ -295,9 +295,14 @@ class TestTcpFallback:
 
                 def datagram_received(self, data, addr):
                     q = Message.decode(data)
-                    resp = Message(id=q.id, qr=True, tc=True,
-                                   questions=list(q.questions))
-                    self.transport.sendto(resp.encode(), addr)
+                    resp = bytearray(Message(
+                        id=q.id, qr=True, tc=True,
+                        questions=list(q.questions)).encode())
+                    # echo the question verbatim like a real server: the
+                    # client 0x20-validates the case mask it sent
+                    qlen = len(resp) - 12
+                    resp[12:] = data[12:12 + qlen]
+                    self.transport.sendto(bytes(resp), addr)
 
             transport, _ = await loop.create_datagram_endpoint(
                 TruncatingServer, local_addr=("127.0.0.1", 0))
@@ -358,3 +363,120 @@ class TestTcpFallback:
         assert len(r.answers) == 100
         addrs = {a.address for a in r.answers}
         assert len(addrs) == 100
+
+
+class TestDns0x20:
+    """The upstream client randomizes the qname's case and only accepts
+    responses echoing the question verbatim — the blind-spoofing
+    mitigation that lets the per-upstream socket be shared
+    (binder_tpu/recursion/client.py _PortProto)."""
+
+    def _fake_upstream(self, loop, echo_verbatim: bool):
+        class Upstream(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                q = Message.decode(data)
+                resp = bytearray(Message(
+                    id=q.id, qr=True,
+                    questions=list(q.questions),
+                    answers=[ARecord(name=q.questions[0].name, ttl=30,
+                                     address="10.3.3.3")]).encode())
+                if echo_verbatim:
+                    qlen = 0
+                    off = 12
+                    while data[off] != 0:
+                        off += 1 + data[off]
+                    qlen = off + 5 - 12
+                    resp[12:12 + qlen] = data[12:12 + qlen]
+                return self.transport.sendto(bytes(resp), addr)
+
+        return loop.create_datagram_endpoint(
+            Upstream, local_addr=("127.0.0.1", 0))
+
+    def test_verbatim_echo_accepted(self):
+        async def run():
+            from binder_tpu.recursion import DnsClient
+            loop = asyncio.get_running_loop()
+            tr, _ = await self._fake_upstream(loop, echo_verbatim=True)
+            port = tr.get_extra_info("sockname")[1]
+            client = DnsClient(timeout=1.0)
+            try:
+                answers = await client.lookup("web.foo.com", Type.A,
+                                              [f"127.0.0.1:{port}"])
+                return answers
+            finally:
+                client.close()
+                tr.close()
+
+        answers = asyncio.run(run())
+        assert answers[0].address == "10.3.3.3"
+
+    def test_case_mangling_upstream_rejected(self):
+        """A response that does not echo the exact case mask (a spoofed
+        or case-normalizing middlebox answer) is silently dropped, so
+        the lookup times out instead of accepting it."""
+        async def run():
+            from binder_tpu.recursion import DnsClient, UpstreamError
+            loop = asyncio.get_running_loop()
+            tr, _ = await self._fake_upstream(loop, echo_verbatim=False)
+            port = tr.get_extra_info("sockname")[1]
+            client = DnsClient(timeout=0.5)
+            try:
+                await client.lookup("web.foo.com", Type.A,
+                                    [f"127.0.0.1:{port}"])
+            except UpstreamError:
+                return True
+            finally:
+                client.close()
+                tr.close()
+            return False
+
+        assert asyncio.run(run())
+
+
+class TestServerCaseEcho:
+    def test_generic_path_echoes_requester_case(self):
+        """dns0x20 server side: mixed-case questions come back with the
+        exact case mask on every path, including the generic resolver
+        (QueryCtx._echo_question_case) — an SRV query cannot take the
+        raw lane, so this pins the generic path."""
+        async def run():
+            server, _ = await start_local({})
+            store = server.zk_cache.store
+            store.put_json("/com/foo/svc", {
+                "type": "service",
+                "service": {"srvce": "_pg", "proto": "_tcp", "port": 1}})
+            store.put_json("/com/foo/svc/lb0",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": "10.0.0.1"}})
+            try:
+                loop = asyncio.get_running_loop()
+                fut = loop.create_future()
+                q = bytearray(make_query("_pg._tcp.svc.foo.com",
+                                         Type.SRV, qid=9).encode())
+                # uppercase some qname letters by hand
+                mangled = bytes(q).replace(b"_pg", b"_pG").replace(
+                    b"svc", b"sVc").replace(b"foo", b"FoO")
+
+                class P(asyncio.DatagramProtocol):
+                    def connection_made(self, t):
+                        t.sendto(mangled)
+
+                    def datagram_received(self, d, a):
+                        if not fut.done():
+                            fut.set_result(d)
+
+                tr, _ = await loop.create_datagram_endpoint(
+                    P, remote_addr=("127.0.0.1", server.udp_port))
+                raw = await asyncio.wait_for(fut, 5)
+                tr.close()
+                return mangled, raw
+            finally:
+                await server.stop()
+
+        mangled, raw = asyncio.run(run())
+        qlen = len("_pg._tcp.svc.foo.com") + 2 + 4
+        assert raw[12:12 + qlen] == mangled[12:12 + qlen]
+        assert Message.decode(raw).rcode == Rcode.NOERROR
