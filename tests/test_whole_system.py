@@ -1,0 +1,200 @@
+"""Whole-system capstone: every subsystem at once, under faults.
+
+Topology (all real protocols, in one process + the native balancer):
+
+    dig-analog client ──UDP──▶ mbalancer ──unix──▶ 2 binder backends
+                                                   │        │
+                                         ZK wire (jute)  recursion (DNS)
+                                                   │        │
+                                     2-member ZK ensemble  remote-DC binder
+                                     (shared ZKEnsembleState)
+
+The individual paths each have their own suites; this test pins the
+*interactions*: per-name invalidation propagating through the balancer
+while recursion traffic flows, a ZK member dying without a SERVFAIL
+window (session resumes on the survivor), and a backend dying with the
+balancer failing over — queries answering correctly throughout.
+"""
+import asyncio
+import json
+import os
+
+import pytest
+
+from binder_tpu.dns import Rcode, Type
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.recursion import DnsClient, Recursion, StaticResolverSource
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.store.zk_client import ZKClient
+from binder_tpu.store.zk_testserver import ZKEnsembleState, ZKTestServer
+
+from tests.test_balancer import (
+    BALANCER,
+    read_stats,
+    start_balancer,
+    udp_ask as _udp_ask,
+)
+from tests.test_full_stack import wait_for
+
+DOMAIN = "foo.com"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BALANCER),
+    reason="mbalancer not built (make -C native)")
+
+
+async def udp_ask(port, name, qtype, qid):
+    # the shared helper (already decodes) with RD set — the clients in
+    # this scenario are recursion-shaped
+    return await _udp_ask(port, name, qtype, qid=qid, rd=True)
+
+
+def test_everything_at_once(tmp_path):
+    sockdir = str(tmp_path)
+
+    async def run():
+        # -- 2-member ZK ensemble over one shared state --
+        state = ZKEnsembleState()
+        zk1 = ZKTestServer(state=state)
+        zk2 = ZKTestServer(state=state)
+        await zk1.start()
+        await zk2.start()
+        connect = f"127.0.0.1:{zk1.port},127.0.0.1:{zk2.port}"
+
+        # registrar seeds the shared tree through member 2
+        writer = ZKClient(address="127.0.0.1", port=zk2.port)
+        writer.start()
+        assert await wait_for(writer.is_connected)
+        await writer.mkdirp("/com/foo/web", json.dumps(
+            {"type": "host", "host": {"address": "10.1.0.1"}}).encode())
+        await writer.mkdirp("/com/foo/api", json.dumps(
+            {"type": "host", "host": {"address": "10.1.0.2"}}).encode())
+
+        # -- remote-DC binder for recursion (fake store is fine there) --
+        rstore = FakeStore()
+        rcache = MirrorCache(rstore, DOMAIN)
+        rstore.put_json("/com/foo/east", {"type": "service",
+                                          "service": {"port": 53}})
+        rstore.put_json("/com/foo/east/db",
+                        {"type": "host",
+                         "host": {"address": "10.99.0.7"}})
+        rstore.start_session()
+        remote = BinderServer(zk_cache=rcache, dns_domain=DOMAIN,
+                              datacenter_name="east", host="127.0.0.1",
+                              port=0, collector=MetricsCollector())
+        await remote.start()
+
+        # -- 2 ZK-backed backends with recursion, behind the balancer --
+        backends = []
+        for i in range(2):
+            client = ZKClient(address=connect, port=2181,
+                              session_timeout_ms=2000)
+            cache = MirrorCache(client, DOMAIN)
+            client.start()
+            recursion = Recursion(
+                zk_cache=cache, dns_domain=DOMAIN,
+                datacenter_name="local",
+                source=StaticResolverSource(
+                    {"east": [f"127.0.0.1:{remote.udp_port}"]}),
+                nic_provider=lambda: [],
+                client=DnsClient(concurrency=2, timeout=2.0))
+            await recursion.wait_ready()
+            server = BinderServer(
+                zk_cache=cache, dns_domain=DOMAIN,
+                datacenter_name="local", recursion=recursion,
+                host="127.0.0.1", port=0,
+                balancer_socket=os.path.join(sockdir, str(i)),
+                collector=MetricsCollector())
+            await server.start()
+            backends.append((client, cache, recursion, server))
+        assert await wait_for(lambda: all(
+            c.lookup("api.foo.com") is not None
+            and c.lookup("api.foo.com").data is not None
+            for _cl, c, _r, _s in backends))
+
+        proc, port = await start_balancer(sockdir)
+        try:
+            await asyncio.sleep(0.4)
+
+            # 1. authoritative A through the balancer (fills its cache)
+            for qid in (1, 2):
+                m = await udp_ask(port, "web.foo.com", Type.A, qid)
+                assert m.rcode == Rcode.NOERROR
+                assert m.answers[0].address == "10.1.0.1"
+
+            # 2. cross-DC recursion through the balancer (never cached)
+            m = await udp_ask(port, "db.east.foo.com", Type.A, 5)
+            assert m.rcode == Rcode.NOERROR
+            assert m.answers[0].address == "10.99.0.7"
+
+            # 3. churn web over ZK: per-name invalidation must ripple
+            # through backend caches AND the balancer, while api stays
+            # cached and recursion keeps working
+            await udp_ask(port, "api.foo.com", Type.A, 6)
+            await writer.set_data("/com/foo/web", json.dumps(
+                {"type": "host",
+                 "host": {"address": "10.1.0.99"}}).encode())
+            assert await wait_for(lambda: all(
+                c.lookup("web.foo.com").data["host"]["address"]
+                == "10.1.0.99" for _cl, c, _r, _s in backends))
+            assert await wait_for(
+                lambda: read_stats(sockdir)["cache_invalidations"] >= 1)
+            m = await udp_ask(port, "web.foo.com", Type.A, 7)
+            assert m.answers[0].address == "10.1.0.99"
+            m = await udp_ask(port, "api.foo.com", Type.A, 8)
+            assert m.answers[0].address == "10.1.0.2"
+            m = await udp_ask(port, "db.east.foo.com", Type.A, 9)
+            assert m.answers[0].address == "10.99.0.7"
+
+            # 4. ZK member 1 dies: sessions resume on member 2, mirrors
+            # keep serving (no SERVFAIL window), watches re-arm
+            sessions_before = [cl._session_id
+                               for cl, _c, _r, _s in backends]
+            await zk1.stop()
+            for qid in range(20, 26):
+                m = await udp_ask(port, "web.foo.com", Type.A, qid)
+                assert m.rcode == Rcode.NOERROR, f"qid {qid}"
+                assert m.answers[0].address == "10.1.0.99"
+            assert await wait_for(lambda: all(
+                cl.is_connected() for cl, _c, _r, _s in backends))
+            assert [cl._session_id
+                    for cl, _c, _r, _s in backends] == sessions_before
+            # a post-failover mutation still propagates
+            await writer.mkdirp("/com/foo/late", json.dumps(
+                {"type": "host",
+                 "host": {"address": "10.1.0.50"}}).encode())
+            assert await wait_for(lambda: all(
+                c.lookup("late.foo.com") is not None
+                and c.lookup("late.foo.com").data is not None
+                for _cl, c, _r, _s in backends))
+            m = await udp_ask(port, "late.foo.com", Type.A, 30)
+            assert m.answers[0].address == "10.1.0.50"
+
+            # 5. backend 0 dies (SIGTERM unlinks its socket): the
+            # balancer fails over and every path keeps answering
+            await backends[0][3].stop()
+            os_path = os.path.join(sockdir, "0")
+            if os.path.exists(os_path):
+                os.unlink(os_path)
+            await asyncio.sleep(0.5)   # balancer sweep notices
+            for qid in range(40, 44):
+                m = await udp_ask(port, "web.foo.com", Type.A, qid)
+                assert m.answers[0].address == "10.1.0.99"
+            m = await udp_ask(port, "db.east.foo.com", Type.A, 50)
+            assert m.answers[0].address == "10.99.0.7"
+        finally:
+            proc.kill()
+            await proc.wait()
+            for client, _c, recursion, server in backends:
+                try:
+                    await server.stop()
+                except Exception:  # noqa: BLE001 — backend 0 already down
+                    pass
+                await recursion.close()
+                client.close()
+            writer.close()
+            await remote.stop()
+            await zk2.stop()
+
+    asyncio.run(run())
