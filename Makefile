@@ -10,8 +10,13 @@ all: native
 native:
 	$(MAKE) -C native
 
+# after the suite, name every conformance tier with ran/skip + reason —
+# a silently skipped tier must be visible in the round log
+CONFORMANCE_STRICT ?=
 test: native
-	$(PY) -m pytest tests/ -q
+	@mkdir -p .scratch
+	$(PY) -m pytest tests/ -q --junitxml=.scratch/junit.xml
+	$(PY) tools/conformance_tiers.py .scratch/junit.xml $(CONFORMANCE_STRICT)
 
 # style/consistency gate (the reference's `make check` runs the vendored
 # jsstyle/javascriptlint, reference Jenkinsfile:37-40; here: byte-compile
@@ -34,9 +39,16 @@ check:
 # gates the reference leaves to production: full test suite + bench
 # smoke.  Explicitly sequential: check's ASan extension swap must not
 # race test's pytest import under `make -j`.
+# ci turns the glibc stub-resolver tier on when running as root (it
+# rewrites /etc/resolv.conf and binds 127.0.0.1:53, so plain `make
+# test` keeps it opt-in) and then requires that at least one
+# independent DNS client actually executed (--strict).
+# BINDER_LIBC_CONFORMANCE=0 runs ci without the host mutation and
+# visibly waives the independence gate (informed opt-out).
 ci:
 	$(MAKE) check
-	$(MAKE) test
+	$(MAKE) test CONFORMANCE_STRICT=--strict \
+		BINDER_LIBC_CONFORMANCE="$${BINDER_LIBC_CONFORMANCE-$$([ "$$(id -u)" = 0 ] && echo 1)}"
 	$(MAKE) bench-smoke
 	@echo "ci: all gates passed"
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Report which independent-conformance tiers ran vs skipped (VERDICT r3
+item 4): a silently skipped tier must be visible in the CI log, because
+the reference's whole test philosophy rests on independent clients
+(reference test/dig.js:109-134, test/helper.js:53-61) and a silently
+absent one voids that guarantee without anyone noticing.
+
+Usage: conformance_tiers.py <junit.xml> [--strict]
+
+Reads the junit report the main `make test` pytest run already emitted
+— ground truth per tier without re-running anything (a re-run would
+rewrite /etc/resolv.conf and bind port 53 a second time), and a tier
+that skipped at RUNTIME (e.g. port 53 already bound) reports as
+skipped even though its static gate was open.  Test failures are the
+pytest invocation's own exit code; this tool only classifies outcomes.
+
+Exit status: 0 normally; with --strict, 1 unless at least one
+independent DNS *client* tier (dig or glibc getent) actually passed —
+the ZooKeeper tier exercises the store client, not the DNS codec, and
+does not satisfy the gate.  An explicit BINDER_LIBC_CONFORMANCE=0
+waives the strict gate (informed operator opt-out) with a visible note.
+"""
+import os
+import sys
+import xml.etree.ElementTree as ET
+
+# tier -> the test class that implements it (tests/test_conformance.py)
+TIERS = [
+    ("rfc-golden-vectors", "TestGoldenVectors"),
+    ("dig(1)", "TestDigConformance"),
+    ("glibc-getent", "TestLibcConformance"),
+    ("real-zookeeper", "TestRealZooKeeper"),
+]
+DNS_CLIENT_TIERS = {"dig(1)", "glibc-getent"}
+MODULE = "tests.test_conformance"
+
+
+def tier_outcomes(junit_path: str):
+    """class name -> [passed, failed, skip_reasons], conformance
+    testcases only."""
+    out = {}
+    for case in ET.parse(junit_path).getroot().iter("testcase"):
+        classname = case.get("classname", "")
+        if not classname.startswith(MODULE):
+            continue
+        cls = classname.rsplit(".", 1)[-1]
+        rec = out.setdefault(cls, [0, 0, []])
+        skip = case.find("skipped")
+        if skip is not None:
+            rec[2].append(skip.get("message") or "skipped")
+        elif case.find("failure") is not None or \
+                case.find("error") is not None:
+            rec[1] += 1
+        else:
+            rec[0] += 1
+    return out
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if a != "--strict"]
+    strict = "--strict" in sys.argv[1:]
+    if len(args) != 1:
+        print("usage: conformance_tiers.py <junit.xml> [--strict]",
+              file=sys.stderr)
+        return 2
+    try:
+        outcomes = tier_outcomes(args[0])
+    except (OSError, ET.ParseError) as e:
+        print(f"conformance_tiers: cannot read junit report "
+              f"{args[0]}: {e}", file=sys.stderr)
+        return 2
+    if not outcomes:
+        print(f"conformance_tiers: no {MODULE} testcases in {args[0]} "
+              f"(wrong file, or the module failed to collect)",
+              file=sys.stderr)
+        return 2
+
+    any_dns_client = False
+    print("conformance tiers (tests/test_conformance.py, actual "
+          "outcomes):")
+    for name, cls in TIERS:
+        passed, failed, reasons = outcomes.get(cls, (0, 0, ["not collected"]))
+        if failed:
+            # already fatal via pytest's own exit status; classify only
+            status, why = "FAILED ", f"{failed} test(s) failed"
+        elif passed:
+            status, why = "ran    ", f"{passed} test(s) passed"
+        else:
+            status = "SKIPPED"
+            why = reasons[0] if reasons else "no tests ran"
+        print(f"  {name:<20} {status} — {why}")
+        if passed and not failed and name in DNS_CLIENT_TIERS:
+            any_dns_client = True
+
+    if not any_dns_client:
+        if os.environ.get("BINDER_LIBC_CONFORMANCE") == "0":
+            print("  note: independence gate waived — "
+                  "BINDER_LIBC_CONFORMANCE=0 set explicitly")
+            return 0
+        print("  WARNING: no independent DNS client executed; codec "
+              "conformance rests on golden vectors alone",
+              file=sys.stderr)
+        return 1 if strict else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
