@@ -62,7 +62,7 @@ class LdapTestServer:
 
     def __init__(self, *, bind_dn: str = "cn=root", password: str = "secret",
                  entries: Optional[Dict[str, Dict[str, List[str]]]] = None,
-                 host: str = "127.0.0.1",
+                 host: str = "127.0.0.1", ssl_context=None,
                  log: Optional[logging.Logger] = None) -> None:
         self.bind_dn = normalize_dn(bind_dn)
         self.password = password
@@ -71,9 +71,11 @@ class LdapTestServer:
         for dn, attrs in (entries or {}).items():
             self.add_entry(dn, attrs)
         self.host = host
+        self.ssl_context = ssl_context   # serve ldaps when set
         self.port: Optional[int] = None
         self.log = log or logging.getLogger("binder.ldap.testserver")
         self._server: Optional[asyncio.base_events.Server] = None
+        self._writers: set = set()   # live client connections
         self.bind_count = 0
         self.search_count = 0
 
@@ -86,13 +88,21 @@ class LdapTestServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle, self.host, 0)
+            self._handle, self.host, 0, ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # drop live clients first: a peer mid-TLS-handshake or
+            # retrying connects keeps a handler alive, and on 3.12+
+            # wait_closed() waits for all handlers — unbounded
+            for w in list(self._writers):
+                w.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
+            except asyncio.TimeoutError:
+                self.log.warning("ldap testserver: wait_closed timed out")
             self._server = None
 
     async def __aenter__(self) -> "LdapTestServer":
@@ -108,6 +118,7 @@ class LdapTestServer:
                       writer: asyncio.StreamWriter) -> None:
         buf = b""
         bound = False
+        self._writers.add(writer)
         try:
             while True:
                 total = ber.frame_length(buf)
@@ -142,6 +153,7 @@ class LdapTestServer:
         except (ber.BerError, ConnectionError, OSError) as e:
             self.log.debug("ldap testserver connection error: %s", e)
         finally:
+            self._writers.discard(writer)
             writer.close()
 
     def _do_bind(self, writer, msgid: int, op: bytes) -> bool:

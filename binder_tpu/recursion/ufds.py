@@ -11,7 +11,8 @@ search -b 'region=<region>, o=smartdc' objectclass=resolver``
   simple bind, search (equality / presence / and / or / not filters),
   unbind.  TLS optional (``ldaps://`` URLs — internal directories use
   self-signed certs, so verification is off by default, matching the
-  reference deployment's ldapjs configuration).
+  reference deployment's ldapjs configuration; the ``recursion.ufds.ca``
+  config knob opts into CA-verified TLS, which the reference cannot do).
 - :class:`UfdsResolverSource` — the :class:`ResolverSource` implementation
   wired into :class:`~binder_tpu.recursion.recursion.Recursion` when the
   config carries ``recursion.ufds.url`` (sapi template
@@ -148,12 +149,21 @@ class LdapClient:
     """Asyncio LDAPv3 client: connect / simple bind / search / unbind."""
 
     def __init__(self, host: str, port: int = 389, *, tls: bool = False,
+                 tls_context: Optional[ssl.SSLContext] = None,
+                 server_name: Optional[str] = None,
                  connect_timeout: float = CONNECT_TIMEOUT,
                  request_timeout: float = REQUEST_TIMEOUT,
                  log: Optional[logging.Logger] = None) -> None:
         self.host = host
         self.port = port
         self.tls = tls
+        # a caller-built verifying context (None keeps the
+        # reference-compatible trust-anything default); server_name is
+        # the certificate identity to check when it differs from the
+        # dialed host (UFDS is dialed by ZK-resolved IP, verified
+        # against its DNS name)
+        self.tls_context = tls_context
+        self.server_name = server_name
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self.log = log or logging.getLogger("binder.ldap")
@@ -168,14 +178,22 @@ class LdapClient:
 
     async def connect(self) -> None:
         sslctx = None
+        kwargs = {}
         if self.tls:
-            sslctx = ssl.create_default_context()
-            # internal DC directory, self-signed certs (reference ldapjs
-            # config does the equivalent)
-            sslctx.check_hostname = False
-            sslctx.verify_mode = ssl.CERT_NONE
+            if self.tls_context is not None:
+                sslctx = self.tls_context
+            else:
+                # internal DC directory, self-signed certs (reference
+                # ldapjs config does the equivalent); opt into
+                # verification via UfdsResolverSource's `ca` knob
+                sslctx = ssl.create_default_context()
+                sslctx.check_hostname = False
+                sslctx.verify_mode = ssl.CERT_NONE
+            if self.server_name:
+                kwargs["server_hostname"] = self.server_name
         self._reader, self._writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port, ssl=sslctx),
+            asyncio.open_connection(self.host, self.port, ssl=sslctx,
+                                    **kwargs),
             self.connect_timeout)
         self._buf = b""
 
@@ -339,6 +357,42 @@ class UfdsResolverSource:
         self.bind_password = config.get("bindPassword", "")
         self.connect_timeout = config.get("connectTimeout", 3000) / 1000.0
         self.request_timeout = config.get("clientTimeout", 120000) / 1000.0
+        # CA verification opt-in (beats the reference: lib/recursion.js
+        # 129-148 trusts any certificate).  `ca` is a PEM bundle path;
+        # when set, the chain is verified against it and the certificate
+        # identity is checked against `tlsServerName` if given, else the
+        # url's DNS name (the dial target itself is usually a
+        # ZK-resolved IP).  Unset keeps the reference-compatible
+        # trust-anything default.  Built once here so a bad CA path is
+        # an immediate config error, not a silently retried warning.
+        self.ca = config.get("ca")
+        self.tls_server_name = config.get("tlsServerName")
+        if self.tls_server_name and not self.ca:
+            # identity pinning without a trust root would silently fall
+            # back to the trust-anything context — refuse instead
+            raise LdapError("ufds.tlsServerName requires ufds.ca")
+        self._tls_context: Optional[ssl.SSLContext] = None
+        self._server_name: Optional[str] = None
+        if self.ca:
+            try:
+                self._tls_context = ssl.create_default_context(
+                    cafile=self.ca)
+            except (OSError, ssl.SSLError) as e:
+                raise LdapError(f"cannot load ufds.ca {self.ca!r}: {e}")
+            url_host = None
+            if self.url:
+                try:
+                    _, h, _ = parse_ldap_url(self.url)
+                except LdapError:
+                    h = None   # init() re-parses and raises with context
+                if h and not _is_address(h):
+                    url_host = h
+            self._server_name = self.tls_server_name or url_host
+            if self._server_name is None:
+                # nothing to check the certificate identity against
+                # (address-literal url, no pinned name): chain
+                # verification only
+                self._tls_context.check_hostname = False
         self.log = log or logging.getLogger("binder.ufds")
         self.client: Optional[LdapClient] = None
         self._addr: Optional[Tuple[str, int, bool]] = None
@@ -375,6 +429,8 @@ class UfdsResolverSource:
             self.client = None
         host, port, tls = self._addr
         client = LdapClient(host, port, tls=tls,
+                            tls_context=self._tls_context,
+                            server_name=self._server_name,
                             connect_timeout=self.connect_timeout,
                             request_timeout=self.request_timeout,
                             log=self.log)

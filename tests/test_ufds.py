@@ -3,9 +3,13 @@ server, and the full recursion bootstrap through the ZK mirror.
 
 The reference's UFDS integration (lib/recursion.js:129-148,202-249) has
 zero automated tests (SURVEY §4); this suite covers the re-derived
-protocol path end to end.
+protocol path end to end, including CA-verified ldaps (the reference's
+ldapjs setup trusts any certificate, lib/recursion.js:129-148).
 """
 import asyncio
+import datetime
+import ipaddress
+import ssl
 
 import pytest
 
@@ -22,6 +26,78 @@ from binder_tpu.recursion.ufds import (
     parse_ldap_url,
 )
 from binder_tpu.store import FakeStore, MirrorCache
+
+# -- in-test PKI for the CA-verification knob -------------------------------
+
+
+def _make_key_and_cert(cn, *, issuer=None, issuer_key=None, ca=False,
+                       san_dns=None):
+    """Self-signed CA (issuer=None) or a leaf signed by one."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (x509.CertificateBuilder()
+         .subject_name(subject)
+         .issuer_name(issuer.subject if issuer is not None else subject)
+         .public_key(key.public_key())
+         .serial_number(x509.random_serial_number())
+         .not_valid_before(now - datetime.timedelta(days=1))
+         .not_valid_after(now + datetime.timedelta(days=30))
+         .add_extension(x509.BasicConstraints(ca=ca, path_length=None),
+                        critical=True))
+    if san_dns:
+        b = b.add_extension(x509.SubjectAlternativeName(
+            [x509.DNSName(san_dns),
+             x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+            critical=False)
+    cert = b.sign(issuer_key if issuer_key is not None else key,
+                  hashes.SHA256())
+    return key, cert
+
+
+class _Pki:
+    pass
+
+
+@pytest.fixture(scope="module")
+def tls_pki(tmp_path_factory):
+    """CA + server cert for ufds.foo.com/127.0.0.1, plus an unrelated
+    'rogue' CA for the negative test."""
+    from cryptography.hazmat.primitives import serialization
+
+    d = tmp_path_factory.mktemp("ufds-pki")
+
+    def pem(path, obj, private=False):
+        if private:
+            data = obj.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption())
+        else:
+            data = obj.public_bytes(serialization.Encoding.PEM)
+        path.write_bytes(data)
+        return str(path)
+
+    ca_key, ca_cert = _make_key_and_cert("binder-test-ca", ca=True)
+    srv_key, srv_cert = _make_key_and_cert(
+        "ufds.foo.com", issuer=ca_cert, issuer_key=ca_key,
+        san_dns="ufds.foo.com")
+    _, rogue_ca_cert = _make_key_and_cert("rogue-ca", ca=True)
+
+    pki = _Pki()
+    pki.ca_pem = pem(d / "ca.pem", ca_cert)
+    pki.rogue_ca_pem = pem(d / "rogue_ca.pem", rogue_ca_cert)
+    cert_pem = pem(d / "server.pem", srv_cert)
+    key_pem = pem(d / "server.key", srv_key, private=True)
+    pki.server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    pki.server_ctx.load_cert_chain(cert_pem, key_pem)
+    return pki
+
 
 RESOLVER_ENTRIES = {
     "uuid=r1, datacenter=east-1, region=home, o=smartdc": {
@@ -249,6 +325,82 @@ class TestUfdsResolverSource:
         first, second, binds = asyncio.run(go())
         assert len(first) == len(second) == 3
         assert binds >= 2
+
+    def test_verified_tls_happy_path(self, tls_pki):
+        # ca knob set: chain verified against the test CA and the cert
+        # identity checked against the url's DNS name, while the dial
+        # target is the ZK-resolved 127.0.0.1
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES,
+                                      ssl_context=tls_pki.server_ctx) as srv:
+                cache = ufds_zk_fixture("127.0.0.1")
+                src = UfdsResolverSource({
+                    "url": f"ldaps://ufds.foo.com:{srv.port}",
+                    "bindDN": "cn=root", "bindPassword": "secret",
+                    "ca": tls_pki.ca_pem})
+                await src.init(cache)
+                res = await src.list_resolvers("home")
+                await src.close()
+                return res
+
+        assert len(asyncio.run(go())) == 3
+
+    def test_verified_tls_rejects_untrusted_ca(self, tls_pki):
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES,
+                                      ssl_context=tls_pki.server_ctx) as srv:
+                src = UfdsResolverSource({
+                    "url": f"ldaps://ufds.foo.com:{srv.port}",
+                    "bindDN": "cn=root", "bindPassword": "secret",
+                    "ca": tls_pki.rogue_ca_pem})
+                with pytest.raises(ssl.SSLError):
+                    await src.init(ufds_zk_fixture("127.0.0.1"))
+
+        asyncio.run(go())
+
+    def test_verified_tls_rejects_name_mismatch(self, tls_pki):
+        # tlsServerName pins the identity; a name the certificate does
+        # not carry must fail even though the chain verifies
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES,
+                                      ssl_context=tls_pki.server_ctx) as srv:
+                src = UfdsResolverSource({
+                    "url": f"ldaps://ufds.foo.com:{srv.port}",
+                    "bindDN": "cn=root", "bindPassword": "secret",
+                    "ca": tls_pki.ca_pem,
+                    "tlsServerName": "evil.example.com"})
+                with pytest.raises(ssl.SSLCertVerificationError):
+                    await src.init(ufds_zk_fixture("127.0.0.1"))
+
+        asyncio.run(go())
+
+    def test_server_name_without_ca_is_a_config_error(self):
+        # identity pinning without a trust root would silently fall back
+        # to the trust-anything context — must refuse at construction
+        with pytest.raises(LdapError):
+            UfdsResolverSource({"url": "ldaps://ufds.foo.com",
+                                "tlsServerName": "ufds.foo.com"})
+
+    def test_bad_ca_path_is_an_immediate_config_error(self):
+        with pytest.raises(LdapError):
+            UfdsResolverSource({"url": "ldaps://ufds.foo.com",
+                                "ca": "/nonexistent/ca.pem"})
+
+    def test_default_tls_still_trusts_anything(self, tls_pki):
+        # no ca knob: reference-compatible posture — the self-signed-ish
+        # server is accepted without verification
+        async def go():
+            async with LdapTestServer(entries=RESOLVER_ENTRIES,
+                                      ssl_context=tls_pki.server_ctx) as srv:
+                src = UfdsResolverSource({
+                    "url": f"ldaps://ufds.foo.com:{srv.port}",
+                    "bindDN": "cn=root", "bindPassword": "secret"})
+                await src.init(ufds_zk_fixture("127.0.0.1"))
+                res = await src.list_resolvers("home")
+                await src.close()
+                return res
+
+        assert len(asyncio.run(go())) == 3
 
     def test_recursion_populates_dcs_from_ufds(self):
         async def go():
