@@ -141,3 +141,47 @@ def test_cli_rejects_invalid_json_output(tmp_path):
     assert res.returncode == 1
     assert "not valid JSON" in res.stderr
     assert not dest.exists()
+
+
+def test_cli_on_change_gating(tmp_path):
+    """--on-change runs exactly when the rendered content actually
+    changed (config-agent's restart-consumers-on-change semantics), and
+    an unchanged render never rewrites the file."""
+    md = tmp_path / "md.json"
+    md.write_text(json.dumps(MANTA_MD))
+    dest = tmp_path / "config.json"
+    stamp = tmp_path / "restarted"
+    hook = f"touch {stamp}"
+
+    # first render: content changed (file absent) -> hook runs
+    res = _run_cli("-m", str(md), "-o", str(dest), "-c", hook)
+    assert res.returncode == 0, res.stderr
+    assert "wrote" in res.stdout
+    assert stamp.exists()
+
+    # identical metadata re-push: no rewrite, no hook
+    stamp.unlink()
+    mtime = dest.stat().st_mtime_ns
+    res = _run_cli("-m", str(md), "-o", str(dest), "-c", hook)
+    assert res.returncode == 0, res.stderr
+    assert "unchanged" in res.stdout
+    assert dest.stat().st_mtime_ns == mtime
+    assert not stamp.exists()
+
+    # metadata change -> rewrite + hook again
+    md.write_text(json.dumps({**MANTA_MD, "DATACENTER": "dc10"}))
+    res = _run_cli("-m", str(md), "-o", str(dest), "-c", hook)
+    assert res.returncode == 0, res.stderr
+    assert stamp.exists()
+    assert json.loads(dest.read_text())["datacenterName"] == "dc10"
+
+
+def test_cli_on_change_hook_failure_surfaces(tmp_path):
+    md = tmp_path / "md.json"
+    md.write_text(json.dumps(MANTA_MD))
+    dest = tmp_path / "config.json"
+    res = _run_cli("-m", str(md), "-o", str(dest), "-c", "exit 7")
+    assert res.returncode == 7
+    assert "on-change command failed" in res.stderr
+    # the config itself IS written — only the consumer restart failed
+    assert json.loads(dest.read_text())["datacenterName"] == "dc9"
