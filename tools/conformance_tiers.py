@@ -24,27 +24,31 @@ import os
 import sys
 import xml.etree.ElementTree as ET
 
-# tier -> the test class that implements it (tests/test_conformance.py)
+# tier -> (module, test class) that implements it
 TIERS = [
-    ("rfc-golden-vectors", "TestGoldenVectors"),
-    ("dig(1)", "TestDigConformance"),
-    ("glibc-getent", "TestLibcConformance"),
-    ("real-zookeeper", "TestRealZooKeeper"),
+    ("rfc-golden-vectors", "tests.test_conformance", "TestGoldenVectors"),
+    ("dig(1)", "tests.test_conformance", "TestDigConformance"),
+    ("glibc-getent", "tests.test_conformance", "TestLibcConformance"),
+    ("real-zookeeper", "tests.test_conformance", "TestRealZooKeeper"),
+    ("real-systemd", "tests.test_systemd_real_conformance",
+     "TestRealSystemd"),
 ]
 DNS_CLIENT_TIERS = {"dig(1)", "glibc-getent"}
-MODULE = "tests.test_conformance"
+MODULES = {m for _, m, _ in TIERS}
 
 
 def tier_outcomes(junit_path: str):
-    """class name -> [passed, failed, skip_reasons], conformance
+    """(module, class) -> [passed, failed, skip_reasons], conformance
     testcases only."""
     out = {}
     for case in ET.parse(junit_path).getroot().iter("testcase"):
         classname = case.get("classname", "")
-        if not classname.startswith(MODULE):
+        if "." not in classname:
             continue
-        cls = classname.rsplit(".", 1)[-1]
-        rec = out.setdefault(cls, [0, 0, []])
+        module, cls = classname.rsplit(".", 1)
+        if module not in MODULES:
+            continue
+        rec = out.setdefault((module, cls), [0, 0, []])
         skip = case.find("skipped")
         if skip is not None:
             rec[2].append(skip.get("message") or "skipped")
@@ -70,16 +74,16 @@ def main() -> int:
               f"{args[0]}: {e}", file=sys.stderr)
         return 2
     if not outcomes:
-        print(f"conformance_tiers: no {MODULE} testcases in {args[0]} "
-              f"(wrong file, or the module failed to collect)",
-              file=sys.stderr)
+        print(f"conformance_tiers: no testcases from {sorted(MODULES)} "
+              f"in {args[0]} (wrong file, or the modules failed to "
+              f"collect)", file=sys.stderr)
         return 2
 
     any_dns_client = False
-    print("conformance tiers (tests/test_conformance.py, actual "
-          "outcomes):")
-    for name, cls in TIERS:
-        passed, failed, reasons = outcomes.get(cls, (0, 0, ["not collected"]))
+    print("conformance tiers (actual outcomes):")
+    for name, module, cls in TIERS:
+        passed, failed, reasons = outcomes.get(
+            (module, cls), (0, 0, ["not collected"]))
         if failed:
             # already fatal via pytest's own exit status; classify only
             status, why = "FAILED ", f"{failed} test(s) failed"
