@@ -357,7 +357,18 @@ struct Balancer {
 
     uint64_t udp_queries = 0, tcp_queries = 0, drops = 0;
     uint64_t cache_hits = 0;
+    uint64_t cache_misses = 0;    /* key built, no fresh entry: forwarded */
+    uint64_t uncacheable = 0;     /* key declined: forwarded, never filled */
     uint64_t cache_invalidations = 0;  /* entries dropped by opcode 1 */
+    /* forward round-trip (query forwarded on a cache miss -> matching
+     * response from the backend), so a topology-axis delta can be
+     * attributed: balancer packet path (hits) vs backend round trip
+     * (misses).  Log2 cells in µs: [<1, <2, <4, ..., <16384, rest]. */
+    static constexpr int kRttCells = 16;
+    uint64_t fwd_rtt_count = 0;
+    double fwd_rtt_sum_s = 0.0;
+    uint64_t fwd_rtt_cells[kRttCells] = {0};
+    size_t backend_wq_peak = 0;   /* high-water backend stream queue */
     uint64_t wq_overflows = 0;    /* frames refused: stream at byte cap */
     uint64_t idle_closes = 0;     /* TCP clients evicted for idleness */
     uint64_t client_evictions = 0; /* evicted to admit a new client */
@@ -603,6 +614,7 @@ struct PendingFill {
     int backend_id = -1;
     uint32_t epoch = 0;
     bool used = false;
+    double sent_at = 0.0;         /* forward time, for the RTT cells */
     uint8_t key[DNSKEY_MAX];
 };
 constexpr size_t kPendingSlots = 8192;   /* power of two */
@@ -694,6 +706,8 @@ void forward_query_to(int idx, const ClientKey &client, uint8_t transport,
         return;
     }
     be.conn.queue_write(make_frame(client, transport, payload, len));
+    if (be.conn.wq_bytes > g_bal.backend_wq_peak)
+        g_bal.backend_wq_peak = be.conn.wq_bytes;
     be.forwarded++;
     be.pending_queued++;
     if (!be.flush_pending) {
@@ -891,6 +905,7 @@ void handle_udp() {
                          * collect more shuffle variants */
                     }
                     /* miss: remember the key so the response can fill */
+                    g_bal.cache_misses++;
                     PendingFill &pf = g_pending_fill[
                         pending_slot(ck, dnskey_rd16(pkt))];
                     pf.client = ck;
@@ -899,10 +914,12 @@ void handle_udp() {
                     pf.backend_id = be.id;
                     pf.epoch = be.epoch;
                     pf.used = true;
+                    pf.sent_at = mono_s();
                     memcpy(pf.key, key, keylen);
                     forward_query_to(idx, ck, kTransportUdp, pkt, plen);
                     continue;
                 }
+                g_bal.uncacheable++;
             }
             forward_query(ck, kTransportUdp, pkt, plen);
         }
@@ -1081,6 +1098,19 @@ void maybe_cache_fill(Backend &be, uint8_t family, const uint8_t *addr16,
     if (!response_matches_key(pf, payload, len))
         return;                                  /* qid reuse / mismatch */
     pf.used = false;
+    /* matched forward->response pair: record the backend round trip */
+    double rtt = mono_s() - pf.sent_at;
+    if (rtt >= 0.0) {
+        g_bal.fwd_rtt_count++;
+        g_bal.fwd_rtt_sum_s += rtt;
+        double us = rtt * 1e6;
+        int cell = 0;
+        while (cell < Balancer::kRttCells - 1 && us >= 1.0) {
+            us /= 2.0;
+            cell++;
+        }
+        g_bal.fwd_rtt_cells[cell]++;
+    }
     if ((payload[3] & 0x0F) == 2)                /* SERVFAIL */
         return;
     backend_cache_insert(be, pf.key, pf.keylen, payload, len,
@@ -1267,30 +1297,39 @@ void handle_stats() {
         int fd = accept4(g_bal.stats_fd, nullptr, nullptr, SOCK_NONBLOCK);
         if (fd < 0) return;
         std::string out = "{\n";
-        /* 12 u64 fields at up to 20 digits each on top of ~250 bytes of
-         * literal text: 512 would truncate near-max counters and emit
-         * unparseable stats JSON */
-        char line[1024];
+        /* ~20 u64 fields at up to 20 digits each on top of ~600 bytes
+         * of literal text: smaller buffers would truncate near-max
+         * counters and emit unparseable stats JSON */
+        char line[2048];
         snprintf(line, sizeof(line),
                  "  \"uptime_ms\": %llu,\n  \"udp_queries\": %llu,\n"
                  "  \"tcp_queries\": %llu,\n  \"drops\": %llu,\n"
-                 "  \"cache_hits\": %llu,\n  \"cache_entries\": %zu,\n"
+                 "  \"cache_hits\": %llu,\n  \"cache_misses\": %llu,\n"
+                 "  \"uncacheable\": %llu,\n  \"cache_entries\": %zu,\n"
                  "  \"cache_invalidations\": %llu,\n"
+                 "  \"fwd_rtt_count\": %llu,\n"
+                 "  \"fwd_rtt_sum_s\": %.6f,\n"
+                 "  \"backend_wq_peak\": %zu,\n"
                  "  \"tcp_clients\": %zu,\n  \"wq_overflows\": %llu,\n"
                  "  \"idle_closes\": %llu,\n"
                  "  \"client_evictions\": %llu,\n"
                  "  \"backend_stalls\": %llu,\n"
-                 "  \"remotes\": %zu,\n  \"backends\": [\n",
+                 "  \"remotes\": %zu,\n",
                  (unsigned long long)(now_ms() - g_bal.started_at),
                  (unsigned long long)g_bal.udp_queries,
                  (unsigned long long)g_bal.tcp_queries,
                  (unsigned long long)g_bal.drops,
                  (unsigned long long)g_bal.cache_hits,
+                 (unsigned long long)g_bal.cache_misses,
+                 (unsigned long long)g_bal.uncacheable,
                  [] { size_t n = 0;
                       for (const auto &b : g_bal.backends)
                           n += b.cache.size();
                       return n; }(),
                  (unsigned long long)g_bal.cache_invalidations,
+                 (unsigned long long)g_bal.fwd_rtt_count,
+                 g_bal.fwd_rtt_sum_s,
+                 g_bal.backend_wq_peak,
                  g_bal.tcp_clients.size(),
                  (unsigned long long)g_bal.wq_overflows,
                  (unsigned long long)g_bal.idle_closes,
@@ -1298,6 +1337,17 @@ void handle_stats() {
                  (unsigned long long)g_bal.backend_stalls,
                  g_bal.remotes.size());
         out += line;
+        /* forward-RTT histogram: log2 µs upper bounds, open-ended last
+         * cell — enough to localize a topology regression to the
+         * backend round trip vs the balancer's own packet path */
+        out += "  \"fwd_rtt_us_cells\": [";
+        for (int c = 0; c < Balancer::kRttCells; c++) {
+            snprintf(line, sizeof(line), "%s%llu",
+                     c == 0 ? "" : ", ",
+                     (unsigned long long)g_bal.fwd_rtt_cells[c]);
+            out += line;
+        }
+        out += "],\n  \"backends\": [\n";
         /* one pass over the affinity map (reference be_remotes), not
          * one scan per backend */
         std::vector<size_t> remote_counts(g_bal.backends.size(), 0);

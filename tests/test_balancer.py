@@ -265,6 +265,14 @@ class TestBalancerCache:
                 assert stats["cache_entries"] == 1
                 assert stats["backends"][0]["forwarded"] == 1
                 assert stats["backends"][0]["gen_known"] is True
+                # per-stage attribution cells: the one forwarded miss
+                # produced one matched round trip, and the histogram
+                # holds exactly that observation
+                assert stats["cache_misses"] == 1, stats
+                assert stats["fwd_rtt_count"] == 1
+                assert stats["fwd_rtt_sum_s"] > 0
+                assert sum(stats["fwd_rtt_us_cells"]) == 1
+                assert stats["backend_wq_peak"] > 0
 
                 # store mutation -> gen frame -> cached entry is stale
                 store.put_json("/com/foo/web",
