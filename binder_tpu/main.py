@@ -134,6 +134,7 @@ async def run(options: Dict[str, object]) -> BinderServer:
         query_log=bool(options.get("queryLog", True)),
         cache_size=int(options.get("size", 10000)),
         cache_expiry_ms=int(options.get("expiry", 60000)),
+        zone_precompile=bool(options.get("zonePrecompile", True)),
         tcp_idle_timeout=(float(options["tcpIdleTimeout"])
                           if "tcpIdleTimeout" in options else None),
         max_tcp_conns=(int(options["maxTcpConns"])

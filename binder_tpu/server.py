@@ -123,6 +123,7 @@ class BinderServer:
                  query_log: bool = True,
                  cache_size: int = 10000,
                  cache_expiry_ms: int = 60000,
+                 zone_precompile: bool = True,
                  tcp_idle_timeout: Optional[float] = None,
                  max_tcp_conns: Optional[int] = None,
                  max_tcp_write_buffer: Optional[int] = None,
@@ -229,6 +230,23 @@ class BinderServer:
             self.engine.fastpath_gate = self._fastpath_active
             self.collector.on_expose(self._fold_fastpath_metrics)
 
+        # Zone precompilation (fpcore.h zone table): finished answer
+        # bodies for the dominant record shapes (host A, PTR) are pushed
+        # into the C drain from the STORE MIRROR — at startup and on
+        # every mirrored mutation — so even the first query for a name
+        # never surfaces to Python.  The reference resolves every cold
+        # name per query (lib/server.js:136); this is the rebuild's
+        # NSD/Knot-style answer to that.  `zonePrecompile: false`
+        # disables it (the bench uses that to keep an honest measurement
+        # of the Python resolve path).
+        self._zone_enabled = (
+            zone_precompile and self._fastpath is not None
+            and hasattr(_fastio, "fastpath_zone_put"))
+        self.zone_serve_counter = self.collector.counter(
+            "binder_zone_serves",
+            "queries answered from precompiled zone entries")
+        self._zone_serve_child = self.zone_serve_counter.labelled({})
+
         # actual bound ports (for tests / ephemeral binds)
         self.udp_port: Optional[int] = None
         self.tcp_port: Optional[int] = None
@@ -314,7 +332,9 @@ class BinderServer:
         """MirrorCache invalidation subscriber: drop the cached answers
         whose dependency tag a store mutation touched — in the Python
         answer cache, the native fast path, and (via opcode-1 control
-        frames) the balancer's cache."""
+        frames) the balancer's cache — then re-push fresh zone entries
+        for names the mirror still holds (drop-then-push makes one
+        mutation event both coherence and zone refill)."""
         wires = []
         for tag in tags:
             self.answer_cache.invalidate_tag(tag)
@@ -329,6 +349,131 @@ class BinderServer:
                     pass
         if wires:
             self.engine.notify_invalidate(wires)
+        if self._zone_enabled:
+            for tag in tags:
+                self._zone_refresh(tag)
+
+    # -- zone precompilation (fpcore.h zone table) --
+
+    def _zone_refresh(self, name: str) -> None:
+        """(Re-)push the precompiled answer for one store name, if the
+        mirror currently resolves it to a shape the zone table serves.
+        Stale entries were already dropped by tag invalidation; absent
+        or ineligible names simply stay un-pushed and resolve through
+        the raw lane / generic path."""
+        try:
+            if name.endswith(".in-addr.arpa"):
+                parts = name.split(".")
+                if len(parts) < 3:
+                    return
+                ip = ".".join(reversed(parts[:-2]))
+                owner = self.zk_cache.reverse_lookup(ip)
+                if owner is not None:
+                    self._zone_push_ptr(name, owner)
+            else:
+                node = self.zk_cache.lookup(name)
+                if node is not None:
+                    self._zone_push_a(name, node)
+        except Exception:
+            # zone fill is an optimization: a push failure must never
+            # break the mutation path that feeds it
+            self.log.exception("zone push failed for %s", name)
+
+    def _zone_host_shape(self, node):
+        """(record, sub, packed_addr, ttl) when `node` is a host-like
+        record the raw lane would answer, else None — the eligibility
+        rules are _raw_lane's, verbatim, so the zone table can never
+        answer a shape the lane would decline."""
+        record = node.data
+        rt = record.get("type") if type(record) is dict else None
+        if rt not in _LANE_HOST_TYPES:
+            return None
+        sub = record.get(rt)
+        if type(sub) is not dict:
+            return None
+        addr = sub.get("address")
+        if type(addr) is not str:
+            return None
+        try:
+            packed = _socket.inet_aton(addr)
+        except (OSError, TypeError):
+            return None
+        if _socket.inet_ntoa(packed) != addr:
+            return None
+        ttl = _lane_ttl(record, sub)
+        if ttl is None:
+            return None
+        return record, sub, packed, ttl
+
+    def _zone_push_a(self, name: str, node) -> None:
+        """Precompile the A answer for a host record (the raw lane's A
+        branch, done once at mutation time instead of per query)."""
+        dd_suffix = self._lane_suffix
+        if dd_suffix is None or not name.endswith(dd_suffix):
+            return
+        stripped = name[:-len(dd_suffix)]
+        dd = self.resolver.dns_domain
+        if (stripped == dd or stripped.endswith(dd_suffix)
+                or stripped == self._lane_dcsuff
+                or stripped.endswith("." + self._lane_dcsuff)):
+            return                      # doubled-suffix policy: REFUSED
+        shape = self._zone_host_shape(node)
+        if shape is None:
+            return
+        _record, _sub, packed, ttl = shape
+        qn = self._qname_wire(name)
+        if qn is None:
+            return
+        body = (b"\xc0\x0c\x00\x01\x00\x01"
+                + struct.pack(">IH", ttl & 0xFFFFFFFF, 4) + packed)
+        try:
+            _fastio.fastpath_zone_put(
+                self._fastpath, b"\x00\x01\x00\x01" + qn,
+                self.zk_cache.epoch, 1, [body], qn)
+        except (TypeError, ValueError, MemoryError) as e:
+            self.log.debug("zone A push skipped for %s: %s", name, e)
+
+    def _zone_push_ptr(self, rev_name: str, owner) -> None:
+        """Precompile the PTR answer for a reverse name (the raw lane's
+        PTR branch; NO dnsDomain suffix policy on the reverse tree,
+        lib/server.js:67-134)."""
+        shape = self._zone_host_shape(owner)
+        if shape is None:
+            return
+        _record, _sub, _packed, ttl = shape
+        target = owner.domain
+        if target.endswith(".arpa"):
+            return                      # parity with the lane's decline
+        tw = self._qname_wire(target)
+        if tw is None:
+            return
+        qn = self._qname_wire(rev_name)
+        if qn is None:
+            return
+        body = (b"\xc0\x0c\x00\x0c\x00\x01"
+                + struct.pack(">IH", ttl & 0xFFFFFFFF, len(tw)) + tw)
+        try:
+            _fastio.fastpath_zone_put(
+                self._fastpath, b"\x00\x0c\x00\x01" + qn,
+                self.zk_cache.epoch, 1, [body], qn)
+        except (TypeError, ValueError, MemoryError) as e:
+            self.log.debug("zone PTR push skipped for %s: %s", rev_name, e)
+
+    def _zone_fill(self) -> None:
+        """Walk the mirror and push every eligible precompiled answer —
+        run at server start for mirrors built before this server
+        subscribed to invalidation events (later arrivals ride
+        _on_store_invalidate)."""
+        if not self._zone_enabled:
+            return
+        for domain, node in list(self.zk_cache.nodes.items()):
+            self._zone_push_a(domain, node)
+            ip = getattr(node, "ip", None)
+            if ip:
+                parts = ip.split(".")
+                if len(parts) == 4 and all(p.isdigit() for p in parts):
+                    self._zone_refresh(
+                        ".".join(reversed(parts)) + ".in-addr.arpa")
 
     def _fastpath_push(self, key, epoch: int, query: QueryCtx,
                        tag: str) -> None:
@@ -710,6 +855,10 @@ class BinderServer:
             if hits_delta > 0:
                 self._cache_hit_child.inc(hits_delta)
             last["hits"] = stats["hits"]
+            zone_delta = stats.get("zone_hits", 0) - last.get("zone_hits", 0)
+            if zone_delta > 0:
+                self._zone_serve_child.inc(zone_delta)
+            last["zone_hits"] = stats.get("zone_hits", 0)
             self._fp_inval_total = stats.get("invalidations", 0)
             for qtype, s in stats["per_qtype"].items():
                 children = self._children_for(qtype)
@@ -809,6 +958,7 @@ class BinderServer:
     # -- lifecycle (lib/server.js:609-657) --
 
     async def start(self) -> None:
+        self._zone_fill()
         if self.balancer_socket:
             await self.engine.listen_balancer(self.balancer_socket)
         self.udp_port = await self.engine.listen_udp(self.host, self.port)

@@ -278,6 +278,8 @@ static PyMethodDef fastio_methods[] = {
      "fastpath_new(size, expiry_ms, lat_buckets, size_buckets) -> capsule"},
     {"fastpath_put", fastpath_put, METH_VARARGS,
      "fastpath_put(cache, key, qtype, gen, wires) -> bool accepted"},
+    {"fastpath_zone_put", fastpath_zone_put, METH_VARARGS,
+     "fastpath_zone_put(cache, zkey, gen, ancount, bodies, tag) -> bool"},
     {"fastpath_drain", fastpath_drain, METH_VARARGS,
      "fastpath_drain(cache, fd, gen, max_n=64) -> (misses, served)"},
     {"fastpath_stats", fastpath_stats, METH_VARARGS,

@@ -255,6 +255,67 @@ fastpath_put(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_zone_put(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule, *bodies;
+    Py_buffer zkeybuf, tagbuf;
+    unsigned long long gen;
+    int ancount;
+
+    if (!PyArg_ParseTuple(args, "Oy*KiOy*", &capsule, &zkeybuf, &gen,
+                          &ancount, &bodies, &tagbuf))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    PyObject *fast = c != NULL
+        ? PySequence_Fast(bodies, "bodies must be a sequence") : NULL;
+    if (fast == NULL) {
+        PyBuffer_Release(&zkeybuf);
+        PyBuffer_Release(&tagbuf);
+        return NULL;
+    }
+    Py_ssize_t nv = PySequence_Fast_GET_SIZE(fast);
+    int rc = 0;
+    if (ancount > 0 && ancount <= 0xFFFF
+            && nv >= 1 && nv <= FP_MAX_VARIANTS) {
+        const uint8_t *body_ptrs[FP_MAX_VARIANTS];
+        uint16_t body_lens[FP_MAX_VARIANTS];
+        int sizes_ok = 1;
+        for (Py_ssize_t i = 0; i < nv; i++) {
+            char *data;
+            Py_ssize_t dlen;
+            if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                        &data, &dlen) < 0) {
+                Py_DECREF(fast);
+                PyBuffer_Release(&zkeybuf);
+                PyBuffer_Release(&tagbuf);
+                return NULL;
+            }
+            if (dlen < 1 || dlen > FP_MAX_WIRE) {
+                sizes_ok = 0;
+                break;
+            }
+            body_ptrs[i] = (const uint8_t *)data;
+            body_lens[i] = (uint16_t)dlen;
+        }
+        if (sizes_ok)
+            rc = fp_zone_put(c, zkeybuf.buf, (size_t)zkeybuf.len,
+                             (uint64_t)gen, (uint16_t)ancount, body_ptrs,
+                             body_lens, (int)nv,
+                             (const uint8_t *)tagbuf.buf,
+                             (size_t)tagbuf.len);
+    }
+    Py_DECREF(fast);
+    PyBuffer_Release(&zkeybuf);
+    PyBuffer_Release(&tagbuf);
+    if (rc < 0)
+        return PyErr_NoMemory();
+    if (rc == 0)
+        Py_RETURN_FALSE;
+    Py_RETURN_TRUE;
+}
+
+PyObject *
 fastpath_invalidate(PyObject *self, PyObject *args)
 {
     (void)self;
@@ -456,12 +517,15 @@ fastpath_stats(PyObject *self, PyObject *args)
         }
     }
     return Py_BuildValue(
-        "{s:K,s:K,s:I,s:K,s:K,s:N}",
+        "{s:K,s:K,s:I,s:K,s:K,s:K,s:I,s:K,s:N}",
         "hits", (unsigned long long)c->hits,
         "lookups", (unsigned long long)c->lookups,
         "entries", (unsigned)c->n_entries,
         "bytes", (unsigned long long)c->total_bytes,
         "invalidations", (unsigned long long)c->invalidations,
+        "zone_hits", (unsigned long long)c->zone_hits,
+        "zone_entries", (unsigned)c->zn_entries,
+        "zone_bytes", (unsigned long long)c->ztotal_bytes,
         "per_qtype", per);
 }
 

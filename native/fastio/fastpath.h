@@ -25,6 +25,7 @@ extern unsigned char fastio_shared_bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
 /* fastpath.c */
 PyObject *fastpath_new(PyObject *self, PyObject *args);
 PyObject *fastpath_put(PyObject *self, PyObject *args);
+PyObject *fastpath_zone_put(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);

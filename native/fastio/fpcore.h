@@ -64,6 +64,40 @@ typedef struct {
     uint64_t size_cells[FP_MAX_BUCKETS + 1];
 } fp_qstat_t;
 
+/*
+ * Zone table: precompiled authoritative answers (NSD/Knot-style zone
+ * compilation, re-designed for a live mirror).  Where the answer cache
+ * above remembers what Python resolved, the zone table is filled from
+ * the STORE MIRROR itself — on every node-data arrival the server
+ * pushes the finished answer body for that name — so even the first
+ * query for a name is served inside the C drain.  The reference
+ * resolves every cold name per query (lib/server.js:136); precompiling
+ * the dominant record shapes is the rebuild's cold-path answer to that.
+ *
+ * Keyed by qtype+qclass+lowercased-wire-qname only (the last keylen-3
+ * bytes of the dnskey) — unlike cache entries, a zone answer does not
+ * depend on RD/EDNS/payload: those are patched/echoed at serve time and
+ * the payload ceiling is re-checked per packet (truncation declines to
+ * Python).  Entries carry the mirror epoch (stale generations are
+ * lazily dropped) and the same dependency-tag invalidation as the
+ * cache, so the one store-mutation path keeps every layer coherent.
+ */
+typedef struct {
+    uint8_t key[FP_MAX_KEY];  /* qtype BE16 + qclass BE16 + qname */
+    uint16_t keylen;
+    uint64_t taghash;
+    uint8_t has_tag;
+    uint8_t alien_tag;        /* tag != own qname: needs the scan path */
+    uint64_t gen;
+    uint16_t qtype;
+    uint16_t ancount;
+    uint8_t n_variants;
+    uint8_t next_variant;
+    uint8_t *bodies[FP_MAX_VARIANTS];     /* answer sections, c0 0c ptrs */
+    uint16_t body_lens[FP_MAX_VARIANTS];
+    int used;
+} fp_zentry_t;
+
 typedef struct {
     fp_entry_t *slots;
     uint32_t mask;            /* slot count - 1 (power of two) */
@@ -79,7 +113,20 @@ typedef struct {
     uint64_t hits;
     uint64_t lookups;
     uint64_t invalidations;   /* entries dropped by fp_invalidate_tag */
+    /* zone table (grown by rehash as the mirror fills) */
+    fp_zentry_t *zslots;
+    uint32_t zmask;
+    uint32_t zn_entries;
+    uint32_t zone_alien_tags; /* entries whose tag != own qname */
+    uint64_t ztotal_bytes;
+    uint64_t zone_hits;
 } fp_cache_t;
+
+/* EDNS OPT echoed on zone serves: root name, type 41, payload 1232,
+ * no flags/options — byte-for-byte server.py _OPT_ECHO_WIRE */
+static const uint8_t fp_opt_echo[11] = {
+    0x00, 0x00, 0x29, 0x04, 0xD0, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00
+};
 
 static inline double
 fp_now(void)
@@ -133,11 +180,34 @@ fp_core_init(fp_cache_t *c, long size, long expiry_ms)
 }
 
 static inline void
+fp_zentry_free(fp_cache_t *c, fp_zentry_t *e)
+{
+    for (int i = 0; i < e->n_variants; i++) {
+        c->ztotal_bytes -= e->body_lens[i];
+        free(e->bodies[i]);
+        e->bodies[i] = NULL;
+    }
+    e->n_variants = 0;
+    if (e->used) {
+        e->used = 0;
+        c->zn_entries--;
+        if (e->alien_tag)
+            c->zone_alien_tags--;
+    }
+}
+
+static inline void
 fp_core_clear(fp_cache_t *c)
 {
     for (uint32_t i = 0; i <= c->mask; i++) {
         if (c->slots[i].used)
             fp_entry_free(c, &c->slots[i]);
+    }
+    if (c->zslots != NULL) {
+        for (uint32_t i = 0; i <= c->zmask; i++) {
+            if (c->zslots[i].used)
+                fp_zentry_free(c, &c->zslots[i]);
+        }
     }
 }
 
@@ -148,6 +218,10 @@ fp_core_free(fp_cache_t *c)
         fp_core_clear(c);
         free(c->slots);
         c->slots = NULL;
+    }
+    if (c->zslots != NULL) {
+        free(c->zslots);
+        c->zslots = NULL;
     }
 }
 
@@ -283,12 +357,188 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
     return 1;
 }
 
+/* ---------------- zone table ---------------- */
+
+#define FP_ZONE_MIN_SLOTS 1024
+#define FP_ZONE_MAX_SLOTS (1u << 24)
+#define FP_ZONE_MAX_BYTES (256u << 20)
+
+/* Grow (or create) the zone slot table so a put can always find a free
+ * probe slot at <=50% load.  Every live entry MUST stay findable
+ * within the FP_PROBE lookup window — an entry displaced past it would
+ * evade fp_zone_find and therefore per-name invalidation, and could
+ * later serve pre-mutation answers: a silent coherence violation.  So
+ * the rehash reinserts under the same bound, retries at double size
+ * when a probe cluster exceeds it, and as a last resort FREES the
+ * unplaceable entry (those names fall back to the Python path until
+ * their next push — slower, never stale).
+ * Returns 0 ok, -1 OOM (table unchanged). */
+static inline int
+fp_zone_ensure(fp_cache_t *c)
+{
+    if (c->zslots != NULL && c->zn_entries * 2 <= c->zmask)
+        return 0;
+    uint32_t want = c->zslots == NULL ? FP_ZONE_MIN_SLOTS
+                                      : (c->zmask + 1) * 2;
+retry:
+    if (want > FP_ZONE_MAX_SLOTS)
+        return -1;
+    fp_zentry_t *ns = (fp_zentry_t *)calloc(want, sizeof(fp_zentry_t));
+    if (ns == NULL)
+        return -1;
+    fp_zentry_t *old = c->zslots;
+    uint32_t old_mask = c->zmask;
+    if (old != NULL) {
+        for (uint32_t i = 0; i <= old_mask; i++) {
+            fp_zentry_t *e = &old[i];
+            if (!e->used)
+                continue;
+            uint64_t h = fp_hash(e->key, e->keylen);
+            int placed = 0;
+            for (uint32_t p = 0; p < FP_PROBE; p++) {
+                fp_zentry_t *t = &ns[(h + p) & (want - 1)];
+                if (!t->used) {
+                    *t = *e;
+                    placed = 1;
+                    break;
+                }
+            }
+            if (!placed) {
+                if (want * 2 <= FP_ZONE_MAX_SLOTS) {
+                    free(ns);           /* cluster > window: go bigger */
+                    want *= 2;
+                    goto retry;
+                }
+                /* at the size cap: drop rather than displace */
+                fp_zentry_free(c, e);
+            }
+        }
+    }
+    c->zslots = ns;
+    c->zmask = want - 1;
+    free(old);
+    return 0;
+}
+
+static inline fp_zentry_t *
+fp_zone_find(fp_cache_t *c, const uint8_t *zkey, size_t zklen)
+{
+    if (c->zslots == NULL)
+        return NULL;
+    uint64_t h = fp_hash(zkey, zklen);
+    for (int p = 0; p < FP_PROBE; p++) {
+        fp_zentry_t *e = &c->zslots[(h + (uint64_t)p) & c->zmask];
+        if (e->used && e->keylen == zklen &&
+            memcmp(e->key, zkey, zklen) == 0)
+            return e;
+    }
+    return NULL;
+}
+
+/*
+ * Insert or replace a precompiled answer.  `zkey` is qtype+qclass+
+ * lowercased wire qname (the dnskey minus its 3 request-dependent
+ * lead bytes); bodies are finished answer sections whose compression
+ * pointers target offset 12.  Returns 1 stored, 0 skipped, -1 OOM.
+ */
+static inline int
+fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
+            uint64_t gen, uint16_t ancount,
+            const uint8_t *const *bodies, const uint16_t *body_lens,
+            int nv, const uint8_t *tag, size_t taglen)
+{
+    if (zklen < 5 || zklen > FP_MAX_KEY)
+        return 0;
+    if (taglen == 0 || taglen > FP_MAX_TAG)
+        return 0;                   /* uninvalidatable: never stale-safe */
+    if (nv < 1 || nv > FP_MAX_VARIANTS || ancount == 0)
+        return 0;
+    uint64_t add = 0;
+    for (int i = 0; i < nv; i++) {
+        if (body_lens[i] == 0 || body_lens[i] > FP_MAX_WIRE)
+            return 0;
+        add += body_lens[i];
+    }
+    if (c->ztotal_bytes + add > FP_ZONE_MAX_BYTES)
+        return 0;
+    if (fp_zone_ensure(c) < 0)
+        return -1;
+
+    uint64_t h = fp_hash(zkey, zklen);
+    fp_zentry_t *target = NULL, *stale = NULL, *oldest = NULL;
+    for (int p = 0; p < FP_PROBE; p++) {
+        fp_zentry_t *e = &c->zslots[(h + (uint64_t)p) & c->zmask];
+        if (e->used && e->keylen == zklen &&
+            memcmp(e->key, zkey, zklen) == 0) {
+            target = e;             /* replace in place */
+            break;
+        }
+        if (!e->used) {
+            if (target == NULL)
+                target = e;
+            continue;
+        }
+        if (e->gen != gen && stale == NULL)
+            stale = e;              /* pre-rebuild leftover: evictable */
+        if (oldest == NULL)
+            oldest = e;
+    }
+    if (target == NULL)
+        target = stale != NULL ? stale : oldest;
+    if (target->used)
+        fp_zentry_free(c, target);
+
+    memcpy(target->key, zkey, zklen);
+    target->keylen = (uint16_t)zklen;
+    target->taghash = fp_hash(tag, taglen);
+    target->has_tag = 1;
+    /* fp_invalidate_tag's O(1) drop rebuilds keys as (A|PTR, IN, tag):
+     * only entries matching that construction exactly may skip the scan
+     * path — tag == own qname AND a directly-probed qtype/class */
+    uint16_t zqtype = (uint16_t)((zkey[0] << 8) | zkey[1]);
+    uint16_t zqclass = (uint16_t)((zkey[2] << 8) | zkey[3]);
+    int alien = !((zqtype == 1 || zqtype == 12) && zqclass == 1 &&
+                  taglen == zklen - 4 &&
+                  memcmp(tag, zkey + 4, taglen) == 0);
+    target->alien_tag = 0;       /* set with `used` below — a mid-fill
+                                  * rollback (used still 0) must not
+                                  * leak the alien count */
+    target->gen = gen;
+    target->qtype = zqtype;
+    target->ancount = ancount;
+    target->next_variant = 0;
+    target->n_variants = 0;
+    for (int i = 0; i < nv; i++) {
+        uint8_t *copy = (uint8_t *)malloc((size_t)body_lens[i]);
+        if (copy == NULL) {
+            fp_zentry_free(c, target);
+            return -1;
+        }
+        memcpy(copy, bodies[i], (size_t)body_lens[i]);
+        target->bodies[i] = copy;
+        target->body_lens[i] = body_lens[i];
+        target->n_variants = (uint8_t)(i + 1);
+        c->ztotal_bytes += (uint64_t)body_lens[i];
+    }
+    target->used = 1;
+    target->alien_tag = (uint8_t)alien;
+    if (alien)
+        c->zone_alien_tags++;
+    c->zn_entries++;
+    return 1;
+}
+
 /*
  * Drop every entry whose dependency tag equals `tag` (a mirrored store
- * mutation changed that name's answers).  Full-table scan: mutation
- * rates (~hundreds/s) times slot counts (thousands) is microseconds of
- * work, and the scan needs no auxiliary index to stay consistent.
- * Returns the number of entries dropped.
+ * mutation changed that name's answers) — in the answer cache AND the
+ * zone table, so one store-mutation path keeps every layer coherent.
+ * Cache: full-table scan (mutation rates ~hundreds/s times thousands of
+ * slots is microseconds, and needs no auxiliary index).  Zone: entries
+ * are tagged with their own qname by construction (A, PTR), so two
+ * O(1) key drops replace the scan; a scan runs only while alien-tagged
+ * entries exist.  The distinction matters at mirror-build time, when
+ * tens of thousands of invalidation events arrive while the zone table
+ * is large.  Returns the number of entries dropped.
  */
 static inline uint32_t
 fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
@@ -297,15 +547,94 @@ fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
         return 0;
     uint64_t h = fp_hash(tag, taglen);
     uint32_t n = 0;
-    for (uint32_t i = 0; i <= c->mask; i++) {
-        fp_entry_t *e = &c->slots[i];
-        if (e->used && e->has_tag && e->taghash == h) {
-            fp_entry_free(c, e);
-            n++;
+    if (c->n_entries > 0) {
+        for (uint32_t i = 0; i <= c->mask; i++) {
+            fp_entry_t *e = &c->slots[i];
+            if (e->used && e->has_tag && e->taghash == h) {
+                fp_entry_free(c, e);
+                n++;
+            }
+        }
+    }
+    if (c->zslots != NULL && c->zn_entries > 0) {
+        if (taglen + 4 <= FP_MAX_KEY) {
+            static const uint16_t qtypes[2] = {1, 12};   /* A, PTR */
+            uint8_t zkey[FP_MAX_KEY];
+            zkey[2] = 0;
+            zkey[3] = 1;                                 /* class IN */
+            memcpy(zkey + 4, tag, taglen);
+            for (int q = 0; q < 2; q++) {
+                zkey[0] = (uint8_t)(qtypes[q] >> 8);
+                zkey[1] = (uint8_t)(qtypes[q] & 0xFF);
+                fp_zentry_t *e = fp_zone_find(c, zkey, taglen + 4);
+                if (e != NULL && e->has_tag && e->taghash == h) {
+                    fp_zentry_free(c, e);
+                    n++;
+                }
+            }
+        }
+        if (c->zone_alien_tags > 0) {
+            for (uint32_t i = 0; i <= c->zmask; i++) {
+                fp_zentry_t *e = &c->zslots[i];
+                if (e->used && e->has_tag && e->taghash == h) {
+                    fp_zentry_free(c, e);
+                    n++;
+                }
+            }
         }
     }
     c->invalidations += n;
     return n;
+}
+
+/*
+ * Serve one packet from the zone table: assemble header + question echo
+ * (original case) + precompiled body + optional OPT echo.  `key` is the
+ * full dnskey (RD/EDNS/payload in its lead bytes), `out` must hold
+ * FP_MAX_WIRE.  Returns response length, or 0 to decline to Python
+ * (miss, stale generation, or would-truncate).
+ */
+static inline size_t
+fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
+              size_t keylen, size_t qn_len, uint64_t gen, uint8_t *out,
+              uint16_t *qtype_out)
+{
+    fp_zentry_t *e = fp_zone_find(c, key + 3, keylen - 3);
+    if (e == NULL)
+        return 0;
+    if (e->gen != gen) {
+        fp_zentry_free(c, e);           /* lazy epoch invalidation */
+        return 0;
+    }
+    int rd = key[0] & 1;
+    int edns = key[0] & 2;
+    unsigned payload = ((unsigned)key[1] << 8) | key[2];
+
+    uint8_t v = e->next_variant;
+    e->next_variant = (uint8_t)((v + 1) % e->n_variants);
+    size_t blen = e->body_lens[v];
+    size_t total = 12 + qn_len + 4 + blen + (edns ? sizeof(fp_opt_echo) : 0);
+    if (total > payload || total > FP_MAX_WIRE)
+        return 0;                       /* truncation semantics: Python */
+
+    out[0] = pkt[0];                    /* request id */
+    out[1] = pkt[1];
+    out[2] = (uint8_t)(0x84 | (rd ? 0x01 : 0));   /* QR|AA, RD echo */
+    out[3] = 0;                         /* RA=0, rcode NOERROR */
+    out[4] = 0; out[5] = 1;             /* QD=1 */
+    out[6] = (uint8_t)(e->ancount >> 8);
+    out[7] = (uint8_t)(e->ancount & 0xFF);
+    out[8] = 0; out[9] = 0;             /* NS=0 */
+    out[10] = 0; out[11] = (uint8_t)(edns ? 1 : 0);
+    memcpy(out + 12, pkt + 12, qn_len + 4);       /* 0x20 case echo */
+    memcpy(out + 12 + qn_len + 4, e->bodies[v], blen);
+    if (edns)
+        memcpy(out + 12 + qn_len + 4 + blen, fp_opt_echo,
+               sizeof(fp_opt_echo));
+    if (qtype_out != NULL)
+        *qtype_out = e->qtype;
+    c->zone_hits++;
+    return total;
 }
 
 /*
@@ -328,7 +657,10 @@ fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
         return 0;
     fp_entry_t *e = fp_find(c, key, keylen, gen, now);
     if (e == NULL)
-        return 0;
+        /* not in the answer cache: a precompiled zone answer still
+         * serves it natively (first query for a name included) */
+        return fp_zone_serve(c, pkt, key, keylen, qn_len, gen, out,
+                             qtype_out);
 
     /* hit: copy the variant, patch id + the client's question bytes
      * (same length by construction — key match implies identical

@@ -30,6 +30,10 @@ uint64_t fz_iter = 0;
 uint64_t fz_gen = 1;
 double fz_clock = 1000.0;
 
+/* tag used by zone-mode iterations to exercise the scan path; shared so
+ * other modes can clear those entries before asserting a miss */
+const uint8_t fz_alien_tag[5] = {3, 'z', 'z', 'z', 0};
+
 /* build a well-formed query: header + one question, hostname-charset
  * name derived from the input bytes */
 size_t build_query(const uint8_t *data, size_t len, uint8_t *q /*512*/) {
@@ -80,10 +84,83 @@ void fuzz_one(const uint8_t *data, size_t len) {
 
     uint8_t out[FP_MAX_WIRE];
 
-    if (fz_iter % 2 == 0) {
-        /* raw client bytes straight into the serve path */
+    if (fz_iter % 3 == 0) {
+        /* raw client bytes straight into the serve path (cache AND
+         * zone lookup paths, via fp_serve_one's miss fall-through) */
         (void)fp_serve_one(fz_c, data, len, fz_gen, fz_clock, out,
                            nullptr);
+    } else if (fz_iter % 3 == 2) {
+        /* zone put + serve round trip: synthesized query, precompiled
+         * body, assert the assembled response */
+        uint8_t q[512];
+        size_t qlen = build_query(data, len, q);
+        uint8_t key[FP_MAX_KEY];
+        size_t qn_len = 0;
+        uint16_t qtype = 0;
+        size_t klen = dnskey_build(q, qlen, key, &qn_len, &qtype);
+        assert(klen > 0 && klen <= FP_MAX_KEY);
+
+        const uint8_t *tag = key + 7;     /* qname wire */
+        size_t taglen = klen - 7;
+        /* clear both layers for this name first, so the serve below is
+         * provably a zone serve (a fill-mode cache entry for the same
+         * name would otherwise shadow it) */
+        (void)fp_invalidate_tag(fz_c, tag, taglen);
+
+        int nv = 1 + (int)(len > 5 ? data[5] % FP_MAX_VARIANTS : 0);
+        uint16_t ancount = (uint16_t)(1 + (len > 6 ? data[6] % 3 : 0));
+        static uint8_t body_store[FP_MAX_VARIANTS][FP_MAX_WIRE];
+        const uint8_t *bodies[FP_MAX_VARIANTS];
+        uint16_t blens[FP_MAX_VARIANTS];
+        for (int i = 0; i < nv; i++) {
+            size_t bl = 1 + (len > (size_t)(7 + i)
+                             ? data[7 + i] * 9u : 16u);
+            if (bl > FP_MAX_WIRE) bl = FP_MAX_WIRE;
+            for (size_t b = 0; b < bl; b++)
+                body_store[i][b] = (uint8_t)(b * 17 + data[0] + i);
+            bodies[i] = body_store[i];
+            blens[i] = (uint16_t)bl;
+        }
+        /* occasionally use an alien tag to drive the zone scan path */
+        int alien = (len > 3 && data[3] % 7 == 0);
+        int rc = fp_zone_put(fz_c, key + 3, klen - 3, fz_gen, ancount,
+                             bodies, blens, nv,
+                             alien ? fz_alien_tag : tag,
+                             alien ? sizeof(fz_alien_tag) : taglen);
+        assert(rc >= 0);
+
+        if (rc == 1) {
+            uint16_t got_qtype = 0;
+            size_t wlen = fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock,
+                                       out, &got_qtype);
+            size_t want = 12 + qn_len + 4 + blens[0];
+            if (want > DNSKEY_CLASSIC_PAYLOAD) {
+                /* would truncate: must decline to the slow path */
+                assert(wlen == 0);
+            } else {
+                assert(wlen == want);
+                assert(out[0] == q[0] && out[1] == q[1]);
+                assert(out[2] == 0x85);   /* QR|AA + RD echo (rd set) */
+                assert(out[3] == 0x00);
+                assert(dnskey_rd16(out + 6) == ancount);
+                assert(out[11] == 0);     /* no EDNS on the query */
+                assert(memcmp(out + 12, q + 12, qn_len + 4) == 0);
+                assert(memcmp(out + 12 + qn_len + 4, bodies[0],
+                              blens[0]) == 0);
+                assert(got_qtype == qtype);
+            }
+            /* usually KEEP the entry so the table fills and the grow/
+             * rehash path runs; every 4th, prove tag invalidation
+             * drops it through whichever path applies (direct key
+             * drop, or the scan while alien-tagged entries exist) */
+            if (len > 2 && data[2] % 4 == 0) {
+                uint32_t dropped = fp_invalidate_tag(
+                    fz_c, alien ? fz_alien_tag : tag,
+                    alien ? sizeof(fz_alien_tag) : taglen);
+                assert(dropped >= 1);
+                assert(fp_zone_find(fz_c, key + 3, klen - 3) == nullptr);
+            }
+        }
     } else {
         uint8_t q[512];
         size_t qlen = build_query(data, len, q);
@@ -129,9 +206,15 @@ void fuzz_one(const uint8_t *data, size_t len) {
 
         if (rc == 1 && fz_iter % 31 == 0) {
             /* tag invalidation: the entry just stored must be dropped
-             * and the following serve must miss */
+             * and the following serve must miss.  Zone-mode iterations
+             * leave persistent entries — qname-tagged ones fall to the
+             * same invalidation, but alien-tagged ones for this name
+             * survive it by design, so clear those first or the serve
+             * below would (correctly) answer from the zone */
             uint32_t dropped = fp_invalidate_tag(fz_c, tag, taglen);
             assert(dropped >= 1);
+            (void)fp_invalidate_tag(fz_c, fz_alien_tag,
+                                    sizeof(fz_alien_tag));
             assert(fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock, out,
                                 nullptr) == 0);
             rc = 0;                     /* skip the hit asserts below */
@@ -179,6 +262,33 @@ void fuzz_one(const uint8_t *data, size_t len) {
         assert(used == fz_c->n_entries);
         assert(fz_c->hits <= fz_c->lookups);
         assert(fz_c->total_bytes <= FP_MAX_TOTAL_BYTES);
+        if (fz_c->zslots != nullptr) {
+            uint64_t zbytes = 0;
+            uint32_t zused = 0, zalien = 0;
+            for (uint32_t i = 0; i <= fz_c->zmask; i++) {
+                const fp_zentry_t *e = &fz_c->zslots[i];
+                if (!e->used) {
+                    assert(e->n_variants == 0);
+                    continue;
+                }
+                zused++;
+                if (e->alien_tag)
+                    zalien++;
+                assert(e->n_variants >= 1);
+                for (int j = 0; j < e->n_variants; j++)
+                    zbytes += e->body_lens[j];
+                /* every live entry must stay findable within the probe
+                 * window — one displaced past it (e.g. by a rehash)
+                 * would evade per-name invalidation and could serve
+                 * stale answers after a later rehash */
+                assert(fp_zone_find(fz_c, e->key, e->keylen) ==
+                       (fp_zentry_t *)e);
+            }
+            assert(zbytes == fz_c->ztotal_bytes);
+            assert(zused == fz_c->zn_entries);
+            assert(zalien == fz_c->zone_alien_tags);
+            assert(fz_c->ztotal_bytes <= FP_ZONE_MAX_BYTES);
+        }
     }
 }
 

@@ -271,6 +271,11 @@ def fixture_store():
 
 async def start_server(cache, **kw):
     kw.setdefault("query_log", False)
+    # this module tests the answer-cache fill/hit flow, which the zone
+    # table would short-circuit (a precompiled host answer means the
+    # first query never surfaces to Python); tests/test_zone.py covers
+    # the zone path itself
+    kw.setdefault("zone_precompile", False)
     server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
                           datacenter_name="coal", host="127.0.0.1", port=0,
                           collector=MetricsCollector(), **kw)

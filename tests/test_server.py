@@ -279,7 +279,11 @@ class TestMetrics:
 
         async def run():
             store, cache = fixture_store()
-            server = await start_server(cache, query_log=False)
+            # zone-precompiled answers never surface to Python (no
+            # latency stamp to promote); the warn path under test is the
+            # raw-lane/generic one
+            server = await start_server(cache, query_log=False,
+                                        zone_precompile=False)
             monkeypatch.setattr(srv_mod, "SLOW_QUERY_MS", -1.0)
             with caplog.at_level(_logging.INFO, logger="binder.server"):
                 await udp_ask(server.udp_port, "web.foo.com", Type.A)

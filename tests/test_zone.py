@@ -1,0 +1,333 @@
+"""Tests for zone precompilation (fpcore.h zone table).
+
+The zone table serves finished answers for the dominant record shapes
+(host A, PTR) inside the C UDP drain, filled from the store mirror at
+server start and on every mutation — so even the FIRST query for a name
+never surfaces to Python.  The reference resolves every cold name per
+query (lib/server.js:136).
+
+Layers here:
+- differential: every zone-served response must be byte-identical to
+  the same server's generic-path response (zonePrecompile off), id
+  aside — the zone can never answer differently, only faster;
+- coherence: store mutations re-point zone answers through the same
+  tag-invalidation path as the caches; deletions fall back to Python;
+- policy: shapes the raw lane declines (service records, doubled
+  dnsDomain suffixes, non-IN classes) are never zone-served.
+"""
+import asyncio
+
+import pytest
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+fastio = pytest.importorskip(
+    "binder_tpu._binderfastio",
+    reason="fastio extension not built (make -C native)")
+if not hasattr(fastio, "fastpath_zone_put"):
+    pytest.skip("fastio extension predates the zone table; rebuild",
+                allow_module_level=True)
+
+DOMAIN = "foo.com"
+
+
+def fixture_store():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "192.168.0.1"}})
+    store.put_json("/com/foo/ttlhost",
+                   {"type": "host", "ttl": 120,
+                    "host": {"address": "10.9.9.9", "ttl": 77}})
+    store.put_json("/com/foo/svc", {
+        "type": "service",
+        "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432},
+    })
+    for i in range(2):
+        store.put_json(f"/com/foo/svc/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+    store.start_session()
+    return store, cache
+
+
+async def start_server(cache, **kw):
+    kw.setdefault("query_log", False)
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="coal", host="127.0.0.1",
+                          port=0, collector=MetricsCollector(), **kw)
+    await server.start()
+    return server
+
+
+async def udp_ask_raw(port, wire, timeout=2.0):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+            transport.sendto(wire)
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        return await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+
+
+def zone_stats(server):
+    return fastio.fastpath_stats(server._fastpath)
+
+
+def _mixed_case(wire: bytes, lower: bytes, mixed: bytes) -> bytes:
+    """Patch a query wire with true mixed-case qname bytes — make_query
+    normalizes to lowercase, so dns0x20 shapes must be crafted at the
+    wire level or the probe is vacuous."""
+    assert lower in wire and lower.lower() == mixed.lower()
+    return wire.replace(lower, mixed)
+
+
+PROBES = [
+    ("A no-edns", make_query("web.foo.com", Type.A, qid=1,
+                             edns_payload=None).encode()),
+    ("A rd", make_query("web.foo.com", Type.A, qid=2, rd=True,
+                        edns_payload=None).encode()),
+    ("A edns", make_query("web.foo.com", Type.A, qid=3,
+                          edns_payload=1400).encode()),
+    ("A 0x20", _mixed_case(
+        make_query("web.foo.com", Type.A, qid=4).encode(),
+        b"\x03web\x03foo\x03com", b"\x03WeB\x03fOo\x03CoM")),
+    ("A ttl precedence", make_query("ttlhost.foo.com", Type.A,
+                                    qid=5).encode()),
+    ("PTR", make_query("1.0.168.192.in-addr.arpa", Type.PTR,
+                       qid=6).encode()),
+    ("PTR 0x20", _mixed_case(
+        make_query("9.9.9.10.in-addr.arpa", Type.PTR, qid=7).encode(),
+        b"\x07in-addr\x04arpa", b"\x07IN-aDdR\x04ArPa")),
+]
+
+
+class TestZoneDifferential:
+    def test_zone_answers_equal_generic_and_never_reach_python(self):
+        """Byte-differential: for every probe shape the zone-enabled
+        server's FIRST response equals the zone-disabled server's, and
+        it really came from the zone (zone_hits advanced, no Python
+        resolve counted)."""
+        async def run():
+            _, cache_on = fixture_store()
+            _, cache_off = fixture_store()
+            on = await start_server(cache_on)
+            off = await start_server(cache_off, zone_precompile=False)
+            try:
+                for label, wire in PROBES:
+                    before = zone_stats(on)["zone_hits"]
+                    got = await udp_ask_raw(on.udp_port, wire)
+                    want = await udp_ask_raw(off.udp_port, wire)
+                    assert got == want, label
+                    assert zone_stats(on)["zone_hits"] == before + 1, \
+                        (label, "expected a zone serve")
+                    if "0x20" in label:
+                        # the requester's exact mixed-case bytes echo
+                        assert wire[12:24] in got, label
+                # and the decoded answer is actually right
+                r = Message.decode(
+                    await udp_ask_raw(
+                        on.udp_port,
+                        make_query("web.foo.com", Type.A, qid=9).encode()))
+                assert r.rcode == Rcode.NOERROR
+                assert r.answers[0].address == "192.168.0.1"
+                # deepest-object-wins TTL precedence baked in at push
+                r = Message.decode(
+                    await udp_ask_raw(
+                        on.udp_port,
+                        make_query("ttlhost.foo.com", Type.A,
+                                   qid=10).encode()))
+                assert r.answers[0].ttl == 77
+            finally:
+                await on.stop()
+                await off.stop()
+
+        asyncio.run(run())
+
+    def test_shapes_the_lane_declines_are_not_zone_served(self):
+        """Service answers (rotation), SRV, and missing names go through
+        Python; the zone table must not have touched them."""
+        async def run():
+            _, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                for q in (make_query("svc.foo.com", Type.A, qid=21),
+                          make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                                     qid=22),
+                          make_query("absent.foo.com", Type.A, qid=23),
+                          make_query("web.foo.com", Type.AAAA, qid=24)):
+                    before = zone_stats(server)["zone_hits"]
+                    resp = Message.decode(
+                        await udp_ask_raw(server.udp_port, q.encode()))
+                    assert zone_stats(server)["zone_hits"] == before, \
+                        q.questions[0]
+                    assert resp.id == q.id
+                # the service round-robin still works (generic path)
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("svc.foo.com", Type.A, qid=25).encode()))
+                assert r.rcode == Rcode.NOERROR and len(r.answers) == 2
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_doubled_suffix_policy_not_pushed(self):
+        """Names the resolver REFUSES by suffix policy (doubled
+        dnsDomain) must never be precompiled even if a store node
+        exists at that domain."""
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            # a real znode whose domain is foo.com.foo.com
+            store.put_json("/com/foo/com/foo",
+                           {"type": "host",
+                            "host": {"address": "10.1.2.3"}})
+            store.start_session()
+            server = await start_server(cache)
+            try:
+                q = make_query("foo.com.foo.com", Type.A, qid=31)
+                resp = Message.decode(
+                    await udp_ask_raw(server.udp_port, q.encode()))
+                assert resp.rcode == Rcode.REFUSED
+                assert zone_stats(server)["zone_hits"] == 0
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+
+class TestZoneCoherence:
+    def test_mutation_repoints_zone_answer(self):
+        """A store mutation must re-point the precompiled answer (drop
+        via tag invalidation + fresh push from the same event) — and the
+        NEW answer is still zone-served, not a Python fallback."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=41).encode()))
+                assert r.answers[0].address == "192.168.0.1"
+
+                store.put_json("/com/foo/web",
+                               {"type": "host",
+                                "host": {"address": "192.168.0.99"}})
+                await asyncio.sleep(0)   # watch delivery (sync store)
+
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=42).encode()))
+                assert r.answers[0].address == "192.168.0.99"
+                assert zone_stats(server)["zone_hits"] == before + 1
+
+                # the reverse tree re-pointed too: old PTR gone, new live
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("99.0.168.192.in-addr.arpa", Type.PTR,
+                               qid=43).encode()))
+                assert r.rcode == Rcode.NOERROR
+                assert r.answers[0].target == "web.foo.com"
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("1.0.168.192.in-addr.arpa", Type.PTR,
+                               qid=44).encode()))
+                assert r.rcode == Rcode.REFUSED
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_deleted_node_falls_back_to_python_refused(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                store.delete("/com/foo/web")
+                await asyncio.sleep(0)
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=51).encode()))
+                assert r.rcode == Rcode.REFUSED
+                assert zone_stats(server)["zone_hits"] == before
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_type_change_host_to_service_drops_zone_entry(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                store.put_json("/com/foo/web", {
+                    "type": "service",
+                    "service": {"srvce": "_x", "proto": "_tcp",
+                                "port": 1}})
+                await asyncio.sleep(0)
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=61).encode()))
+                # service with no children: NODATA-ish per engine policy;
+                # what matters here is the zone did NOT serve stale host
+                assert zone_stats(server)["zone_hits"] == before
+                assert not r.answers or \
+                    r.answers[0].address != "192.168.0.1"
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_zone_precompile_off_serves_nothing_from_zone(self):
+        async def run():
+            _, cache = fixture_store()
+            server = await start_server(cache, zone_precompile=False)
+            try:
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=71).encode()))
+                assert r.answers[0].address == "192.168.0.1"
+                assert zone_stats(server)["zone_hits"] == 0
+                assert zone_stats(server)["zone_entries"] == 0
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_zone_serves_fold_into_metrics(self):
+        """Zone serves surface in the Prometheus scrape: the per-qtype
+        request counter advances and binder_zone_serves counts them."""
+        async def run():
+            _, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                for i in range(3):
+                    await udp_ask_raw(
+                        server.udp_port,
+                        make_query("web.foo.com", Type.A,
+                                   qid=80 + i).encode())
+                text = server.collector.expose()
+                assert 'binder_zone_serves_total 3' in text.replace(
+                    "binder_zone_serves 3", "binder_zone_serves_total 3")
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
