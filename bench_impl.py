@@ -19,6 +19,8 @@ import json
 import os
 import re
 import select
+import shutil
+import socket as _socket_mod
 import subprocess
 import sys
 import tempfile
@@ -28,6 +30,55 @@ from typing import Dict, List
 from binder_tpu.dns import Type, make_query
 
 ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# ---------------------------------------------------------------------------
+# Core pinning (VERDICT r3 item 1): on a multi-core box the server stack
+# and the load generator share cores by scheduler whim, which is exactly
+# the noise that made r2->r3 driver numbers uninterpretable.  With >=2
+# cores, pin the serving processes (binder, balancer, zk) to the first
+# half and the load drivers to the second half so every pass measures
+# the same contention topology.  Single-core boxes run unpinned (there
+# is nothing to separate) and say so in the env fingerprint.
+
+NPROC = os.cpu_count() or 1
+TASKSET = shutil.which("taskset")
+PINNED = bool(TASKSET) and NPROC >= 2 and \
+    os.environ.get("BENCH_PIN", "1") != "0"
+_SPLIT = NPROC // 2
+SERVER_CORES = f"0-{_SPLIT - 1}" if _SPLIT > 1 else "0"
+CLIENT_CORES = f"{_SPLIT}-{NPROC - 1}" if NPROC - _SPLIT > 1 \
+    else str(_SPLIT)
+
+
+def _pin(role: str) -> List[str]:
+    """argv prefix pinning `role` ('server'|'client') to its core set."""
+    if not PINNED:
+        return []
+    return [TASKSET, "-c",
+            SERVER_CORES if role == "server" else CLIENT_CORES]
+
+
+def _env_fingerprint() -> Dict[str, object]:
+    """Recorded in the bench JSON so cross-round/cross-box numbers are
+    interpretable (VERDICT r3: 'records nothing about the environment,
+    so cross-round driver numbers are uninterpretable')."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except OSError:
+        load1 = None
+    return {"cpu": model, "cores": NPROC, "pinned": PINNED,
+            "server_cores": SERVER_CORES if PINNED else None,
+            "client_cores": CLIENT_CORES if PINNED else None,
+            "loadavg_start": load1, "passes": N_PASSES}
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", "50000"))
 # hot-axis passes: p99 on a single shared-core box varies ±40% run to
 # run (see docs/bench.md), so the headline is the median-by-qps of
@@ -130,8 +181,9 @@ def _launch_server(config: str) -> subprocess.Popen:
     """The one place a bench server process is spawned — every axis
     must run the identical launch incantation."""
     return subprocess.Popen(
-        [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
-         "-p", "0"],
+        _pin("server")
+        + [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
+           "-p", "0"],
         cwd=ROOT, env=_bench_env(), stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL)
 
@@ -254,28 +306,72 @@ def _drive_native(port: int, tmpdir: str, tmpl_path: str = None,
     n = N_QUERIES if n is None else n
     assert n <= 65536, "dnsblast qid/state space"
     out = subprocess.run(
-        [DNSBLAST, "-p", str(port), "-n", str(n),
-         "-w", str(CONCURRENCY), "-t", tmpl_path],
+        _pin("client")
+        + [DNSBLAST, "-p", str(port), "-n", str(n),
+           "-w", str(CONCURRENCY), "-t", tmpl_path],
         capture_output=True, text=True, timeout=330, check=True)
     return json.loads(out.stdout)
 
 
 def _median_passes(drive, passes: int) -> Dict[str, float]:
     """Run `drive` N times; return the median-by-qps pass annotated with
-    the p99 spread across passes (single-box p99 noise diagnostic)."""
+    the qps and p99 spreads across passes — EVERY multi-pass axis
+    carries its own noise band (VERDICT r3 item 1), so a cross-round
+    delta inside the band is never mistaken for a regression."""
     results = [drive() for _ in range(passes)]
     results.sort(key=lambda r: r["qps"])
     res = dict(results[len(results) // 2])
+    res["qps_spread"] = round(results[-1]["qps"] - results[0]["qps"], 1)
     p99s = [r["p99_us"] for r in results]
     res["p99_spread_us"] = round(max(p99s) - min(p99s), 1)
     res["passes"] = passes
     return res
 
 
+def _read_balancer_stats(sockdir: str) -> Dict[str, object]:
+    """One shot of the balancer's stats socket (docs/balancer-protocol.md)."""
+    s = _socket_mod.socket(_socket_mod.AF_UNIX)
+    s.settimeout(5)
+    try:
+        s.connect(os.path.join(sockdir, ".balancer.stats"))
+        buf = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+    finally:
+        s.close()
+    return json.loads(buf)
+
+
+def _rtt_p99_us(stats: Dict[str, object]) -> object:
+    """p99 upper bound from the balancer's log2-µs RTT cells; None when
+    the p99 observation lands in the open-ended last cell (no honest
+    upper bound exists — `balstat` prints it as >=16384us)."""
+    n = stats.get("fwd_rtt_count", 0)
+    cells = stats.get("fwd_rtt_us_cells") or []
+    if not n or not cells:
+        return None
+    run = 0
+    for i, c in enumerate(cells):
+        run += c
+        if run >= 0.99 * n:
+            return float(1 << i) if i < len(cells) - 1 else None
+    return None
+
+
 def _bench_miss(tmpdir: str) -> Dict[str, float]:
-    """Cache-cold axis: N_MISS distinct names, each queried exactly
-    once, so every query runs the full resolve path (no answer-cache,
-    no native fast path reuse).  Fresh server per pass; median of 3."""
+    """Cache-cold axis: N_MISS distinct names, each queried exactly once
+    against a fresh server — answer-cache/fast-path reuse is
+    structurally impossible.  Since round 4 the production cold path for
+    host records is the precompiled zone table (fpcore.h): the mirror
+    pushes finished answers at build time, so first queries serve from
+    the C drain.  The axis therefore measures what a user actually gets
+    on a cold name; the `engine_qps` sub-figure re-runs the same
+    workload with `zonePrecompile: false` so the Python resolve path —
+    the path every non-precompiled shape still takes — keeps its own
+    regression gate.  Fresh server per pass; median of N_PASSES."""
     fixture = os.path.join(tmpdir, "miss_fixture.json")
     with open(fixture, "w") as f:
         json.dump({f"/com/bench/m{i}": {
@@ -286,22 +382,36 @@ def _bench_miss(tmpdir: str) -> Dict[str, float]:
     tmpl = os.path.join(tmpdir, "miss_queries.bin")
     _write_templates(tmpl, [(f"m{i}.bench.com", Type.A)
                             for i in range(N_MISS)])
-    config = os.path.join(tmpdir, "miss_config.json")
-    with open(config, "w") as f:
-        json.dump({"dnsDomain": "bench.com", "datacenterName": "dc0",
-                   "host": "127.0.0.1",
-                   "store": {"backend": "fake", "fixture": fixture},
-                   "queryLog": False}, f)
 
-    def one_pass() -> Dict[str, float]:
-        proc = _launch_server(config)
-        try:
-            port = wait_for_port(proc)
-            return _drive_native(port, tmpdir, tmpl_path=tmpl, n=N_MISS)
-        finally:
-            _reap(proc)
+    def axis(zone: bool) -> Dict[str, float]:
+        config = os.path.join(tmpdir, f"miss_config_{int(zone)}.json")
+        with open(config, "w") as f:
+            json.dump({"dnsDomain": "bench.com", "datacenterName": "dc0",
+                       "host": "127.0.0.1",
+                       "store": {"backend": "fake", "fixture": fixture},
+                       "queryLog": False, "zonePrecompile": zone}, f)
 
-    return _median_passes(one_pass, N_PASSES)
+        def one_pass() -> Dict[str, float]:
+            proc = _launch_server(config)
+            try:
+                port = wait_for_port(proc)
+                return _drive_native(port, tmpdir, tmpl_path=tmpl,
+                                     n=N_MISS)
+            finally:
+                _reap(proc)
+
+        return _median_passes(one_pass, N_PASSES)
+
+    res = axis(zone=True)
+    try:
+        eng = axis(zone=False)
+        res["engine_qps"] = round(eng["qps"], 1)
+        res["engine_qps_spread"] = eng.get("qps_spread")
+        res["engine_p99_us"] = round(eng["p99_us"], 1)
+    except Exception as e:  # noqa: BLE001 — sub-figure is supplementary
+        print(f"bench: miss engine sub-axis failed: {e!r}",
+              file=sys.stderr)
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -344,8 +454,9 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
     from binder_tpu.store.zk_client import ZKClient
 
     zk_proc = subprocess.Popen(
-        [sys.executable, "-u", "-m", "binder_tpu.store.zk_testserver",
-         "0"],
+        _pin("server")
+        + [sys.executable, "-u", "-m", "binder_tpu.store.zk_testserver",
+           "0"],
         cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         env=_bench_env())
     srv_proc = None
@@ -423,9 +534,11 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
         total = 0
         p99s = []
         p50s = []
+        wqps = []
         for _ in range(3):   # ~3 windows of 50k under sustained churn
             blast = await asyncio.create_subprocess_exec(
-                DNSBLAST, "-p", str(port), "-n", str(N_QUERIES),
+                *_pin("client"), DNSBLAST,
+                "-p", str(port), "-n", str(N_QUERIES),
                 "-w", str(CONCURRENCY), "-t", tmpl,
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.DEVNULL)
@@ -436,6 +549,7 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
             total += N_QUERIES
             p99s.append(r["p99_us"])
             p50s.append(r["p50_us"])
+            wqps.append(r["qps"])
         elapsed = time.perf_counter() - t0
         # snapshot with elapsed: the churner keeps running through the
         # balancer windows below, and a later read would inflate the
@@ -460,7 +574,8 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
                 await asyncio.sleep(0.5)   # backend scan + connect
                 for i in range(2):
                     blast = await asyncio.create_subprocess_exec(
-                        DNSBLAST, "-p", str(bal_port), "-n",
+                        *_pin("client"), DNSBLAST,
+                        "-p", str(bal_port), "-n",
                         str(N_QUERIES), "-w", str(CONCURRENCY),
                         "-t", tmpl,
                         stdout=asyncio.subprocess.PIPE,
@@ -490,7 +605,9 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
                 f"churner failed mid-run: {churn_task.exception()!r}")
         churn_task.cancel()
         out = {"qps": total / elapsed, "p50_us": sorted(p50s)[1],
-               "p99_us": max(p99s), "mutations": direct_mutations,
+               "p99_us": max(p99s),
+               "qps_spread": round(max(wqps) - min(wqps), 1),
+               "mutations": direct_mutations,
                "mutations_per_s": direct_mutations / elapsed}
         if topo_qps is not None:
             out["topo_qps"] = topo_qps
@@ -565,8 +682,11 @@ def _bench_recursion(tmpdir: str) -> Dict[str, float]:
                                      qid=1, rd=True).encode(),
                     "recursion path")
 
-        return _drive_native(port, tmpdir, tmpl_path=tmpl,
-                             n=N_RECURSION)
+        # recursion responses are never cached (do-not-store marker),
+        # so repeat passes measure the identical cold forwarding path
+        return _median_passes(
+            lambda: _drive_native(port, tmpdir, tmpl_path=tmpl,
+                                  n=N_RECURSION), N_PASSES)
     finally:
         for p in (local, remote):
             if p is not None:
@@ -578,8 +698,9 @@ def _launch_balancer(sockdir: str):
     (proc, port).  Shared by the topology and balancer-churn axes so
     both measure an identically configured balancer."""
     bal = subprocess.Popen(
-        [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-         "-s", "300"],
+        _pin("server")
+        + [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+           "-s", "300"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
     try:
         port = _wait_for_line(bal, rb"PORT (\d+)\n", "mbalancer")
@@ -589,20 +710,25 @@ def _launch_balancer(sockdir: str):
     return bal, port
 
 
-def _bench_topology(tmpdir: str) -> Dict[str, float]:
-    """Deployment-shape measurement: mbalancer fronting 2 backends over
-    the balancer socket protocol, driven with the same query mix.  Two
-    passes; the second (warm balancer cache) is reported."""
-    sockdir = os.path.join(tmpdir, "vsock")
+def _bench_topology(tmpdir: str, n_backends: int = 2,
+                    tag: str = "") -> Dict[str, float]:
+    """Deployment-shape measurement: mbalancer fronting `n_backends`
+    over the balancer socket protocol, driven with the same query mix.
+    One warm-up pass, then median of N_PASSES with spread; the
+    balancer's per-stage counters (cache hit rate, forward RTT, write
+    queue high-water) ride along so a cross-round delta on this axis
+    can be attributed to a stage instead of bisected blind."""
+    sockdir = os.path.join(tmpdir, f"vsock{tag}")
     os.mkdir(sockdir)
     fixture = os.path.join(tmpdir, "fixture.json")
-    with open(fixture, "w") as f:
-        json.dump(FIXTURE, f)
+    if not os.path.exists(fixture):
+        with open(fixture, "w") as f:
+            json.dump(FIXTURE, f)
 
     procs = []   # every child, reaped on any exit path
     try:
-        for i in range(2):
-            config = os.path.join(tmpdir, f"bconfig{i}.json")
+        for i in range(n_backends):
+            config = os.path.join(tmpdir, f"bconfig{tag}{i}.json")
             with open(config, "w") as f:
                 json.dump({
                     "dnsDomain": "bench.com", "datacenterName": "dc0",
@@ -617,9 +743,21 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
         bal, port = _launch_balancer(sockdir)
         procs.append(bal)
         time.sleep(0.5)   # backend scan + connect
-        res = None
-        for _ in range(2):   # pass 1 warms the balancer cache
-            res = _drive_native(port, tmpdir)
+        _drive_native(port, tmpdir)          # warm the balancer cache
+        res = _median_passes(lambda: _drive_native(port, tmpdir),
+                             N_PASSES)
+        try:
+            stats = _read_balancer_stats(sockdir)
+            served = stats.get("cache_hits", 0) + \
+                stats.get("cache_misses", 0) + stats.get("uncacheable", 0)
+            res["cache_hit_pct"] = round(
+                100.0 * stats.get("cache_hits", 0) / served, 1) \
+                if served else None
+            res["fwd_rtt_p99_us"] = _rtt_p99_us(stats)
+            res["backend_wq_peak"] = stats.get("backend_wq_peak")
+        except (OSError, ValueError) as e:
+            print(f"bench: balancer stats read failed: {e!r}",
+                  file=sys.stderr)
         return res
     finally:
         for p in reversed(procs):   # balancer first, then backends
@@ -627,7 +765,8 @@ def _bench_topology(tmpdir: str) -> Dict[str, float]:
 
 
 def run_bench() -> Dict[str, object]:
-    topo = miss = churn = recur = None
+    env = _env_fingerprint()   # loadavg sampled before any load
+    topo = miss = churn = recur = fronted1 = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -664,6 +803,18 @@ def run_bench() -> Dict[str, object]:
                 topo = _bench_topology(tmpdir)
             except Exception:
                 topo = None   # topology figure is supplementary
+            try:
+                # balancer-overhead isolation (VERDICT r3 item 2): the
+                # SAME workload against ONE backend, balancer-fronted —
+                # compared against the direct headline (one backend, no
+                # balancer, same mix/driver/pinning) this isolates the
+                # balancer's own packet path from backend fan-out
+                fronted1 = _bench_topology(tmpdir, n_backends=1,
+                                           tag="f1")
+            except Exception as e:
+                print(f"bench: balancer-overhead axis failed: {e!r}",
+                      file=sys.stderr)
+                fronted1 = None
 
     baseline = miss_baseline = None
     legacy_baseline = False   # round-1 file predating the miss axis
@@ -712,6 +863,7 @@ def run_bench() -> Dict[str, object]:
         "value": round(res["qps"], 1),
         "unit": "qps",
         "vs_baseline": round(res["qps"] / baseline, 3),
+        "qps_spread": res.get("qps_spread"),
         "p50_us": round(res["p50_us"], 1),
         "p99_us": round(res["p99_us"], 1),
         "p99_spread_us": res.get("p99_spread_us"),
@@ -721,16 +873,26 @@ def run_bench() -> Dict[str, object]:
         "concurrency": CONCURRENCY,
     }
     if miss is not None:
-        # cache-cold axis: full resolve path, every name queried once
+        # cache-cold axis: every name queried exactly once (zone
+        # precompile = the production cold path; engine_* = the Python
+        # resolve path with precompile off, its own regression gate)
         out["miss_qps"] = round(miss["qps"], 1)
+        out["miss_qps_spread"] = miss.get("qps_spread")
         out["miss_p50_us"] = round(miss["p50_us"], 1)
         out["miss_p99_us"] = round(miss["p99_us"], 1)
         out["miss_vs_baseline"] = round(miss["qps"] / miss_baseline, 3)
         out["miss_queries"] = N_MISS
+        if "engine_qps" in miss:
+            out["miss_engine_qps"] = miss["engine_qps"]
+            out["miss_engine_qps_spread"] = miss.get("engine_qps_spread")
+            out["miss_engine_p99_us"] = miss.get("engine_p99_us")
+            out["miss_engine_vs_baseline"] = round(
+                miss["engine_qps"] / miss_baseline, 3)
     if churn is not None:
         # hot mix under sustained store mutation via the real ZK wire
         # protocol: watch delivery + per-name invalidation under load
         out["churn_qps"] = round(churn["qps"], 1)
+        out["churn_qps_spread"] = churn.get("qps_spread")
         out["churn_p50_us"] = round(churn["p50_us"], 1)
         out["churn_p99_us"] = round(churn["p99_us"], 1)
         out["churn_mutations_per_s"] = round(churn["mutations_per_s"], 1)
@@ -743,10 +905,23 @@ def run_bench() -> Dict[str, object]:
         # cross-DC forwarding (BASELINE.json proxy config 'recursive
         # resolution'): per-query upstream round trip, never cached
         out["recursion_qps"] = round(recur["qps"], 1)
+        out["recursion_qps_spread"] = recur.get("qps_spread")
         out["recursion_p50_us"] = round(recur["p50_us"], 1)
         out["recursion_p99_us"] = round(recur["p99_us"], 1)
     if topo is not None:
-        # supplementary: deployment shape (balancer + 2 backends), warm
+        # supplementary: deployment shape (balancer + 2 backends), warm,
+        # with the balancer's own per-stage attribution riding along
         out["topology_qps"] = round(topo["qps"], 1)
+        out["topology_qps_spread"] = topo.get("qps_spread")
         out["topology_p50_us"] = round(topo["p50_us"], 1)
+        out["topology_cache_hit_pct"] = topo.get("cache_hit_pct")
+        out["topology_fwd_rtt_p99_us"] = topo.get("fwd_rtt_p99_us")
+        out["topology_backend_wq_peak"] = topo.get("backend_wq_peak")
+    if fronted1 is not None:
+        # balancer-overhead isolation: identical workload, one backend,
+        # fronted vs the direct headline above
+        out["balancer_fronted1_qps"] = round(fronted1["qps"], 1)
+        out["balancer_overhead_pct"] = round(
+            (1.0 - fronted1["qps"] / res["qps"]) * 100.0, 1)
+    out["env"] = env
     return out
