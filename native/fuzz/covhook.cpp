@@ -1,0 +1,74 @@
+/*
+ * Coverage hook for the coverage-guided fuzz builds (fuzz_*_cov).
+ *
+ * clang/libFuzzer is not in this image; gcc still emits a call to
+ * __sanitizer_cov_trace_pc in every basic block under
+ * -fsanitize-coverage=trace-pc.  This TU supplies that callback —
+ * compiled WITHOUT the coverage flag (see native/Makefile), or the
+ * callback would instrument itself into infinite recursion — and folds
+ * the return address into an AFL-style edge map: prev-location XOR
+ * current-location, bucketed hit counts.  fuzz_util.h detects the hook
+ * through weak symbols and switches fuzz::run into the
+ * coverage-guided loop (keep inputs that light new map cells, write
+ * them back to the corpus dir).
+ */
+#include <stdint.h>
+#include <string.h>
+
+extern "C" {
+
+int fuzz_cov_available = 1;
+
+enum { FUZZ_COV_MAP_SIZE = 1 << 16 };
+static uint8_t cov_map[FUZZ_COV_MAP_SIZE];
+static uintptr_t cov_prev;
+static int cov_on;
+
+uint8_t *fuzz_cov_map = cov_map;
+/* non-const: a namespace-scope const would get internal linkage and
+ * leave the weak extern in fuzz_util.h dangling */
+unsigned fuzz_cov_map_size = FUZZ_COV_MAP_SIZE;
+
+/* Collection is gated: the fuzz driver (mutate/scan/save loop) lives in
+ * the instrumented TU too, so with the gate open its own edges would
+ * occupy map cells — and the novelty scan would mutate the map while
+ * reading it — letting harness-only behavior count as "fresh" target
+ * coverage and persist junk corpus entries.  cov_run_one opens the
+ * gate only around the fuzz_one call. */
+void
+fuzz_cov_collect(int on)
+{
+    cov_on = on;
+}
+
+void
+fuzz_cov_reset(void)
+{
+    memset(cov_map, 0, sizeof(cov_map));
+    cov_prev = 0;
+}
+
+void
+__sanitizer_cov_trace_pc(void)
+{
+    if (!cov_on)
+        return;
+    /* PCs are rebased against the first call site so the map is stable
+     * across runs despite ASLR — otherwise every run would "discover"
+     * the whole corpus again and re-save near-duplicates forever */
+    static uintptr_t base;
+    uintptr_t pc = (uintptr_t)__builtin_return_address(0);
+    if (base == 0)
+        base = pc;
+    uintptr_t off = pc - base;
+    uintptr_t cur = (off >> 4) ^ (off << 9);
+    uint8_t *cell = &cov_map[(cur ^ cov_prev) & (FUZZ_COV_MAP_SIZE - 1)];
+    /* saturate: a wrapping counter reads 256 hits as 0 (coverage lost)
+     * and aliases hot edges into low buckets run-to-run (spurious
+     * novelty — corpus bloat) */
+    if (*cell != 0xFF)
+        (*cell)++;
+    cov_prev = cur >> 1;
+}
+
+}  /* extern "C" */

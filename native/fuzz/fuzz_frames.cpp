@@ -52,8 +52,11 @@ void plant_pending(const ClientKey &ck, uint16_t qid) {
 
 void fuzz_setup() {
     /* logmsg() fires per protocol error — i.e. on most mutated inputs;
-     * success is the exit code */
-    int devnull = open("/dev/null", O_WRONLY);
+     * success is the exit code.  FUZZ_KEEP_STDERR=1 keeps the stream
+     * for debugging a silent nonzero exit (sanitizer reports and
+     * fail-fast messages land here too). */
+    int devnull = getenv("FUZZ_KEEP_STDERR") != nullptr
+        ? -1 : open("/dev/null", O_WRONLY);
     if (devnull >= 0) {
         dup2(devnull, 2);
         close(devnull);
@@ -106,7 +109,8 @@ void fuzz_one(const uint8_t *data, size_t len) {
         memcpy(frame.data() + 7, ck.addr, 16);
         frame[23] = (uint8_t)(ck.port >> 8);
         frame[24] = (uint8_t)(ck.port & 0xff);
-        memcpy(frame.data() + 4 + kFrameHdr, data, plen);
+        if (plen > 0)   /* empty input: data may be null (UB in memcpy) */
+            memcpy(frame.data() + 4 + kFrameHdr, data, plen);
         (void)backend_consume(be, frame.data(), frame.size());
     }
 

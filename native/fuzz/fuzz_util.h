@@ -126,6 +126,115 @@ inline void mutate(std::vector<uint8_t> &b, Rng &rng, const Corpus &corpus) {
     }
 }
 
+/* ---- coverage-guided mode (fuzz_*_cov builds) ----
+ *
+ * When covhook.cpp is linked and the target is compiled with
+ * -fsanitize-coverage=trace-pc, these weak symbols resolve and run()
+ * switches to a coverage-guided loop: execute, diff the edge map
+ * against the accumulated "virgin" map (AFL-style bucketed hit
+ * counts), keep inputs that light new cells, and write them back to
+ * the corpus dir for the mutational smoke and future cov runs to seed
+ * from.  Without the hook (plain fuzz_* builds) the weak symbols are
+ * null and the original deterministic mutational loop runs.
+ */
+extern "C" int fuzz_cov_available __attribute__((weak));
+extern "C" uint8_t *fuzz_cov_map __attribute__((weak));
+extern "C" unsigned fuzz_cov_map_size __attribute__((weak));
+extern "C" void fuzz_cov_reset(void) __attribute__((weak));
+extern "C" void fuzz_cov_collect(int on) __attribute__((weak));
+
+/* AFL hit-count bucketing: 1, 2, 3, 4-7, 8-15, 16-31, 32-127, 128+ */
+inline uint8_t cov_bucket(uint8_t n) {
+    if (n == 0) return 0;
+    if (n == 1) return 1;
+    if (n == 2) return 2;
+    if (n == 3) return 4;
+    if (n < 8)  return 8;
+    if (n < 16) return 16;
+    if (n < 32) return 32;
+    if (n < 128) return 64;
+    return 128;
+}
+
+/* run one input under the map; OR newly-bucketed cells into `virgin`;
+ * returns 1 when the input produced a bucket bit not seen before */
+inline int cov_run_one(const uint8_t *data, size_t len,
+                       std::vector<uint8_t> &virgin) {
+    fuzz_cov_reset();
+    fuzz_cov_collect(1);        /* only the target run is measured —
+                                 * harness edges must not count */
+    fuzz_one(data, len);
+    fuzz_cov_collect(0);
+    int fresh = 0;
+    for (unsigned i = 0; i < fuzz_cov_map_size; i++) {
+        uint8_t b = cov_bucket(fuzz_cov_map[i]);
+        if (b & ~virgin[i]) {
+            virgin[i] |= b;
+            fresh = 1;
+        }
+    }
+    return fresh;
+}
+
+inline void cov_save(const char *dir, const std::vector<uint8_t> &b) {
+    uint64_t h = 1469598103934665603ull;
+    for (uint8_t c : b) {
+        h ^= c;
+        h *= 1099511628211ull;
+    }
+    char path[4096];
+    snprintf(path, sizeof(path), "%s/cov-%016llx", dir,
+             (unsigned long long)h);
+    FILE *fp = fopen(path, "wb");
+    if (fp == nullptr) return;
+    fwrite(b.data(), 1, b.size(), fp);
+    fclose(fp);
+}
+
+inline int run_cov(const char *dir, long iters, uint64_t seed,
+                   const char *argv0) {
+    Corpus corpus = load_corpus(dir);
+    Rng rng(seed);
+    fuzz_setup();
+    std::vector<uint8_t> virgin(fuzz_cov_map_size, 0);
+    /* Stateful targets make some coverage order-dependent, so every
+     * run finds a few "new" cells; persisting those would grow the
+     * checked-in corpus on every CI smoke.  FUZZ_COV_NO_SAVE=1 (the
+     * smoke) keeps finds in memory only; `make fuzz` persists. */
+    const int save = getenv("FUZZ_COV_NO_SAVE") == nullptr;
+
+    /* seeds first: they define the baseline coverage (and must never
+     * regress) */
+    for (const auto &c : corpus)
+        (void)cov_run_one(c.data(), c.size(), virgin);
+
+    long saved = 0;
+    std::vector<uint8_t> buf;
+    for (long i = 0; i < iters; i++) {
+        /* bias toward recent finds: they sit on fresh edges */
+        uint32_t n = (uint32_t)corpus.size();
+        uint32_t idx = (rng.below(4) == 0 && n > 4)
+            ? n - 1 - rng.below(n / 4) : rng.below(n);
+        buf = corpus[idx];
+        mutate(buf, rng, corpus);
+        if (cov_run_one(buf.data(), buf.size(), virgin)) {
+            if (save)
+                cov_save(dir, buf);
+            corpus.push_back(buf);
+            saved++;
+        }
+    }
+    unsigned lit = 0;
+    for (uint8_t v : virgin)
+        if (v) lit++;
+    fprintf(stderr,
+            "fuzz: %s: %ld coverage-guided execs ok (seed %llu, corpus "
+            "%zu, +%ld new inputs, %u/%u map cells)\n",
+            argv0, iters, (unsigned long long)seed, corpus.size(), saved,
+            lit, fuzz_cov_map_size);
+    return 0;
+}
+
 inline int run(int argc, char **argv) {
     if (argc < 2) {
         fprintf(stderr, "usage: %s <corpus_dir> [iterations] [seed]\n",
@@ -134,6 +243,8 @@ inline int run(int argc, char **argv) {
     }
     long iters = argc > 2 ? atol(argv[2]) : 50000;
     uint64_t seed = argc > 3 ? strtoull(argv[3], nullptr, 0) : 1;
+    if (&fuzz_cov_available != nullptr && fuzz_cov_reset != nullptr)
+        return run_cov(argv[1], iters, seed, argv[0]);
     Corpus corpus = load_corpus(argv[1]);
     Rng rng(seed);
     fuzz_setup();
