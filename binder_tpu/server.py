@@ -39,7 +39,12 @@ from binder_tpu.metrics.collector import (
     MetricsCollector,
 )
 from binder_tpu.resolver.answer_cache import AnswerCache
-from binder_tpu.resolver.engine import DEFAULT_TTL, Resolver
+from binder_tpu.resolver.engine import (
+    DEFAULT_TTL,
+    Resolver,
+    SERVICE_CHILD_TYPES as _SERVICE_CHILD_TYPES,
+    _record_ttl as _engine_record_ttl,
+)
 from binder_tpu.utils.jsonlog import log_event
 from binder_tpu.utils.probes import ProbeProvider
 
@@ -372,7 +377,12 @@ class BinderServer:
                     self._zone_push_ptr(name, owner)
             else:
                 node = self.zk_cache.lookup(name)
-                if node is not None:
+                if node is None:
+                    pass
+                elif (type(node.data) is dict
+                        and node.data.get("type") == "service"):
+                    self._zone_push_service_a(name, node)
+                else:
                     self._zone_push_a(name, node)
         except Exception:
             # zone fill is an optimization: a push failure must never
@@ -408,15 +418,8 @@ class BinderServer:
     def _zone_push_a(self, name: str, node) -> None:
         """Precompile the A answer for a host record (the raw lane's A
         branch, done once at mutation time instead of per query)."""
-        dd_suffix = self._lane_suffix
-        if dd_suffix is None or not name.endswith(dd_suffix):
+        if not self._zone_suffix_ok(name):
             return
-        stripped = name[:-len(dd_suffix)]
-        dd = self.resolver.dns_domain
-        if (stripped == dd or stripped.endswith(dd_suffix)
-                or stripped == self._lane_dcsuff
-                or stripped.endswith("." + self._lane_dcsuff)):
-            return                      # doubled-suffix policy: REFUSED
         shape = self._zone_host_shape(node)
         if shape is None:
             return
@@ -432,6 +435,84 @@ class BinderServer:
                 self.zk_cache.epoch, 1, [body], qn)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone A push skipped for %s: %s", name, e)
+
+    def _zone_suffix_ok(self, name: str) -> bool:
+        """The raw lane's dnsDomain suffix policy (a doubled suffix is
+        REFUSED, never answered) — shared by every forward zone push."""
+        dd_suffix = self._lane_suffix
+        if dd_suffix is None or not name.endswith(dd_suffix):
+            return False
+        stripped = name[:-len(dd_suffix)]
+        dd = self.resolver.dns_domain
+        return not (stripped == dd or stripped.endswith(dd_suffix)
+                    or stripped == self._lane_dcsuff
+                    or stripped.endswith("." + self._lane_dcsuff))
+
+    def _zone_push_service_a(self, name: str, node) -> None:
+        """Precompile the plain-A rotation for a service record
+        (engine._resolve_service's A branch, done once at mutation time):
+        one variant per cyclic rotation of the member set, so serves
+        round-robin like the shuffled generic path.  Declines (leaving
+        the Python path authoritative) on anything _resolve_service
+        would not answer as a plain multi-A set: invalid child records
+        (SERVFAIL), empty member sets (NODATA), non-int TTLs,
+        non-canonical addresses."""
+        if not self._zone_suffix_ok(name):
+            return
+        record = node.data
+        if not (type(record) is dict
+                and type(record.get("service")) is dict):
+            return                      # engine SERVFAILs: decline
+        s = record["service"]
+        ttl = _engine_record_ttl(record, s)
+        if type(s.get("service")) is dict:
+            s = s["service"]            # nested historical format
+        if s.get("ttl") is not None:
+            ttl = s["ttl"]
+        if type(ttl) is not int:
+            return
+
+        answers = []
+        for knode in node.children:
+            krec = knode.data
+            if not (type(krec) is dict
+                    and krec.get("type") in _SERVICE_CHILD_TYPES):
+                continue                # engine filters these out too
+            ksub = krec.get(krec["type"])
+            if type(ksub) is not dict:
+                return                  # engine SERVFAILs mid-set
+            addr = ksub.get("address")
+            if addr is None:
+                continue                # engine skips addressless kids
+            if type(addr) is not str:
+                return
+            try:
+                packed = _socket.inet_aton(addr)
+            except (OSError, TypeError):
+                return                  # encode would fail: decline
+            if _socket.inet_ntoa(packed) != addr:
+                return
+            rttl = _engine_record_ttl(krec, ksub, ttl)
+            if type(rttl) is not int:
+                return
+            answers.append(
+                (b"\xc0\x0c\x00\x01\x00\x01"
+                 + struct.pack(">IH", min(ttl, rttl) & 0xFFFFFFFF, 4)
+                 + packed))
+        if not answers:
+            return                      # NODATA shape: Python answers
+        qn = self._qname_wire(name)
+        if qn is None:
+            return
+        nv = min(len(answers), 8)       # FP_MAX_VARIANTS
+        bodies = [b"".join(answers[i:] + answers[:i]) for i in range(nv)]
+        try:
+            _fastio.fastpath_zone_put(
+                self._fastpath, b"\x00\x01\x00\x01" + qn,
+                self.zk_cache.epoch, len(answers), bodies, qn)
+        except (TypeError, ValueError, MemoryError) as e:
+            self.log.debug("zone service push skipped for %s: %s",
+                           name, e)
 
     def _zone_push_ptr(self, rev_name: str, owner) -> None:
         """Precompile the PTR answer for a reverse name (the raw lane's
@@ -467,7 +548,7 @@ class BinderServer:
         if not self._zone_enabled:
             return
         for domain, node in list(self.zk_cache.nodes.items()):
-            self._zone_push_a(domain, node)
+            self._zone_refresh(domain)
             ip = getattr(node, "ip", None)
             if ip:
                 parts = ip.split(".")

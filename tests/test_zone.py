@@ -158,15 +158,14 @@ class TestZoneDifferential:
 
         asyncio.run(run())
 
-    def test_shapes_the_lane_declines_are_not_zone_served(self):
-        """Service answers (rotation), SRV, and missing names go through
-        Python; the zone table must not have touched them."""
+    def test_shapes_the_zone_declines_are_not_zone_served(self):
+        """SRV (additionals section), missing names, and non-A qtypes go
+        through Python; the zone table must not have touched them."""
         async def run():
             _, cache = fixture_store()
             server = await start_server(cache)
             try:
-                for q in (make_query("svc.foo.com", Type.A, qid=21),
-                          make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                for q in (make_query("_pg._tcp.svc.foo.com", Type.SRV,
                                      qid=22),
                           make_query("absent.foo.com", Type.A, qid=23),
                           make_query("web.foo.com", Type.AAAA, qid=24)):
@@ -176,11 +175,118 @@ class TestZoneDifferential:
                     assert zone_stats(server)["zone_hits"] == before, \
                         q.questions[0]
                     assert resp.id == q.id
-                # the service round-robin still works (generic path)
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_service_a_rotation_zone_served(self):
+        """Service plain-A answers come precompiled: full member set per
+        answer (content equal to the generic path's), served natively,
+        rotating so every member leads over repeated queries."""
+        async def run():
+            _, cache_on = fixture_store()
+            _, cache_off = fixture_store()
+            on = await start_server(cache_on)
+            off = await start_server(cache_off, zone_precompile=False)
+            try:
+                def addrsets(r):
+                    return sorted((a.address, a.ttl) for a in r.answers)
+
+                want = Message.decode(await udp_ask_raw(
+                    off.udp_port,
+                    make_query("svc.foo.com", Type.A, qid=90).encode()))
+                leads = set()
+                for i in range(6):
+                    before = zone_stats(on)["zone_hits"]
+                    got = Message.decode(await udp_ask_raw(
+                        on.udp_port,
+                        make_query("svc.foo.com", Type.A,
+                                   qid=91 + i).encode()))
+                    assert zone_stats(on)["zone_hits"] == before + 1
+                    assert got.rcode == Rcode.NOERROR
+                    assert addrsets(got) == addrsets(want)
+                    leads.add(got.answers[0].address)
+                # both members lead at least once (cyclic rotation)
+                assert leads == {"10.0.1.1", "10.0.1.2"}
+            finally:
+                await on.stop()
+                await off.stop()
+
+        asyncio.run(run())
+
+    def test_service_member_mutation_repoints_rotation(self):
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
                 r = Message.decode(await udp_ask_raw(
                     server.udp_port,
-                    make_query("svc.foo.com", Type.A, qid=25).encode()))
-                assert r.rcode == Rcode.NOERROR and len(r.answers) == 2
+                    make_query("svc.foo.com", Type.A, qid=95).encode()))
+                assert {a.address for a in r.answers} == \
+                    {"10.0.1.1", "10.0.1.2"}
+                store.put_json("/com/foo/svc/lb2",
+                               {"type": "load_balancer",
+                                "load_balancer": {"address": "10.0.1.3"}})
+                await asyncio.sleep(0)
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("svc.foo.com", Type.A, qid=96).encode()))
+                assert {a.address for a in r.answers} == \
+                    {"10.0.1.1", "10.0.1.2", "10.0.1.3"}
+                assert zone_stats(server)["zone_hits"] == before + 1
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_service_min_ttl_matches_generic(self):
+        """min(service-ttl, member-ttl) parity (lib/server.js:403-414)
+        must be baked into the precompiled bodies."""
+        async def run():
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/tsvc", {
+                "type": "service", "ttl": 100,
+                "service": {"srvce": "_x", "proto": "_tcp", "port": 1}})
+            store.put_json("/com/foo/tsvc/m0",
+                           {"type": "load_balancer", "ttl": 40,
+                            "load_balancer": {"address": "10.3.0.1"}})
+            store.put_json("/com/foo/tsvc/m1",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": "10.3.0.2"}})
+            store.start_session()
+            server = await start_server(cache)
+            try:
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("tsvc.foo.com", Type.A, qid=97).encode()))
+                assert zone_stats(server)["zone_hits"] == before + 1
+                ttls = {a.address: a.ttl for a in r.answers}
+                assert ttls == {"10.3.0.1": 40, "10.3.0.2": 100}
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_service_with_invalid_member_declines_to_python(self):
+        """A structurally invalid member makes the generic path SERVFAIL
+        mid-set; the zone must decline rather than answer differently."""
+        async def run():
+            store, cache = fixture_store()
+            store.put_json("/com/foo/svc/bad",
+                           {"type": "load_balancer",
+                            "load_balancer": "not-a-dict"})
+            server = await start_server(cache)
+            try:
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("svc.foo.com", Type.A, qid=98).encode()))
+                assert zone_stats(server)["zone_hits"] == before
+                assert r.rcode == Rcode.SERVFAIL
             finally:
                 await server.stop()
 
