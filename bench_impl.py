@@ -40,14 +40,20 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 # the same contention topology.  Single-core boxes run unpinned (there
 # is nothing to separate) and say so in the env fingerprint.
 
-NPROC = os.cpu_count() or 1
+# the ALLOWED set, not os.cpu_count(): in a cpuset-restricted container
+# the machine may have 64 cores while this process is allowed {4,5} —
+# taskset onto disallowed IDs would kill every pinned child at launch
+try:
+    _CORES = sorted(os.sched_getaffinity(0))
+except (AttributeError, OSError):
+    _CORES = list(range(os.cpu_count() or 1))
+NPROC = len(_CORES)
 TASKSET = shutil.which("taskset")
 PINNED = bool(TASKSET) and NPROC >= 2 and \
     os.environ.get("BENCH_PIN", "1") != "0"
 _SPLIT = NPROC // 2
-SERVER_CORES = f"0-{_SPLIT - 1}" if _SPLIT > 1 else "0"
-CLIENT_CORES = f"{_SPLIT}-{NPROC - 1}" if NPROC - _SPLIT > 1 \
-    else str(_SPLIT)
+SERVER_CORES = ",".join(str(c) for c in _CORES[:_SPLIT]) or "0"
+CLIENT_CORES = ",".join(str(c) for c in _CORES[_SPLIT:]) or "0"
 
 
 def _pin(role: str) -> List[str]:
