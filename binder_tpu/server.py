@@ -9,6 +9,7 @@ socket (``lib/server.js:609-653``).
 from __future__ import annotations
 
 import logging
+import re
 import socket as _socket
 import struct
 import threading
@@ -73,6 +74,11 @@ def strip_suffix(suffix: str, s: str) -> str:
 # CLASS=payload 1232, TTL 0, RDLEN 0 — byte-identical to the generic
 # path's _ECHO_OPT (dns/query.py) encoding.
 _OPT_ECHO_WIRE = b"\x00" + struct.pack(">HHIH", 41, 1232, 0, 0)
+
+# one label of a registered srvce/proto pair, exactly what one group of
+# the engine's SRV_RE can match — zone SRV entries are only pushed for
+# qnames the engine would parse back to the same service
+_SRV_LABEL_RE = re.compile(r"^_[^_.]*$")
 
 # Record types the raw lane may answer directly: exactly the host-likes
 # the resolver maps to a single A record (resolver/engine.py:213-216).
@@ -382,6 +388,7 @@ class BinderServer:
                 elif (type(node.data) is dict
                         and node.data.get("type") == "service"):
                     self._zone_push_service_a(name, node)
+                    self._zone_push_service_srv(name, node)
                 else:
                     self._zone_push_a(name, node)
         except Exception:
@@ -448,6 +455,59 @@ class BinderServer:
                     or stripped == self._lane_dcsuff
                     or stripped.endswith("." + self._lane_dcsuff))
 
+    @staticmethod
+    def _zone_service_ttl(record):
+        """``(s, ttl)`` from a service record — the sub-record after the
+        nested-historical-format unwrap plus the engine's TTL precedence
+        (engine.resolve + _resolve_service head) — or None when the
+        shape would not resolve as a service."""
+        if not (type(record) is dict
+                and type(record.get("service")) is dict):
+            return None                 # engine SERVFAILs: decline
+        s = record["service"]
+        ttl = _engine_record_ttl(record, s)
+        if type(s.get("service")) is dict:
+            s = s["service"]            # nested historical format
+        if s.get("ttl") is not None:
+            ttl = s["ttl"]
+        if type(ttl) is not int:
+            return None
+        return s, ttl
+
+    def _zone_service_members(self, node, ttl):
+        """Validated member list ``[(knode, ksub, packed_addr, rttl)]``
+        for a service node — the one place the member eligibility rules
+        live, consumed by both the plain-A and the SRV push so the two
+        zone paths cannot drift.  None when the generic path would
+        SERVFAIL mid-set or a value would fail to encode (decline to
+        Python); addressless or foreign-typed kids are skipped exactly
+        like engine._resolve_service does."""
+        members = []
+        for knode in node.children:
+            krec = knode.data
+            if not (type(krec) is dict
+                    and krec.get("type") in _SERVICE_CHILD_TYPES):
+                continue                # engine filters these out too
+            ksub = krec.get(krec["type"])
+            if type(ksub) is not dict:
+                return None             # engine SERVFAILs mid-set
+            addr = ksub.get("address")
+            if addr is None:
+                continue                # engine skips addressless kids
+            if type(addr) is not str:
+                return None
+            try:
+                packed = _socket.inet_aton(addr)
+            except (OSError, TypeError):
+                return None             # encode would fail: decline
+            if _socket.inet_ntoa(packed) != addr:
+                return None
+            rttl = _engine_record_ttl(krec, ksub, ttl)
+            if type(rttl) is not int:
+                return None
+            members.append((knode, ksub, packed, rttl))
+        return members
+
     def _zone_push_service_a(self, name: str, node) -> None:
         """Precompile the plain-A rotation for a service record
         (engine._resolve_service's A branch, done once at mutation time):
@@ -459,48 +519,18 @@ class BinderServer:
         non-canonical addresses."""
         if not self._zone_suffix_ok(name):
             return
-        record = node.data
-        if not (type(record) is dict
-                and type(record.get("service")) is dict):
-            return                      # engine SERVFAILs: decline
-        s = record["service"]
-        ttl = _engine_record_ttl(record, s)
-        if type(s.get("service")) is dict:
-            s = s["service"]            # nested historical format
-        if s.get("ttl") is not None:
-            ttl = s["ttl"]
-        if type(ttl) is not int:
+        head = self._zone_service_ttl(node.data)
+        if head is None:
             return
-
-        answers = []
-        for knode in node.children:
-            krec = knode.data
-            if not (type(krec) is dict
-                    and krec.get("type") in _SERVICE_CHILD_TYPES):
-                continue                # engine filters these out too
-            ksub = krec.get(krec["type"])
-            if type(ksub) is not dict:
-                return                  # engine SERVFAILs mid-set
-            addr = ksub.get("address")
-            if addr is None:
-                continue                # engine skips addressless kids
-            if type(addr) is not str:
-                return
-            try:
-                packed = _socket.inet_aton(addr)
-            except (OSError, TypeError):
-                return                  # encode would fail: decline
-            if _socket.inet_ntoa(packed) != addr:
-                return
-            rttl = _engine_record_ttl(krec, ksub, ttl)
-            if type(rttl) is not int:
-                return
-            answers.append(
-                (b"\xc0\x0c\x00\x01\x00\x01"
-                 + struct.pack(">IH", min(ttl, rttl) & 0xFFFFFFFF, 4)
-                 + packed))
-        if not answers:
+        _s, ttl = head
+        members = self._zone_service_members(node, ttl)
+        if not members:
             return                      # NODATA shape: Python answers
+        answers = [
+            (b"\xc0\x0c\x00\x01\x00\x01"
+             + struct.pack(">IH", min(ttl, rttl) & 0xFFFFFFFF, 4)
+             + packed)
+            for _knode, _ksub, packed, rttl in members]
         qn = self._qname_wire(name)
         if qn is None:
             return
@@ -513,6 +543,86 @@ class BinderServer:
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone service push skipped for %s: %s",
                            name, e)
+
+    def _zone_push_service_srv(self, name: str, node) -> None:
+        """Precompile the SRV answer set for a service record under its
+        registered ``srvce.proto.name`` qname (engine._resolve_service's
+        SRV branch): per member per port an SRV answer at the
+        service-level TTL, plus one A additional per member at the
+        member TTL, rotating together.  The dependency tag is the
+        service NODE name — not the SRV qname — so these entries live in
+        the C side's alien table and are invalidated by its bounded
+        scan.  Negative SRV shapes (wrong srvce/proto → NXDOMAIN, SRV on
+        a non-service → NODATA+SOA, malformed qnames → REFUSED) are
+        never pushed and keep resolving through Python."""
+        if not self._zone_suffix_ok(name):
+            return
+        head = self._zone_service_ttl(node.data)
+        if head is None:
+            return
+        s, ttl = head
+        srvce, proto = s.get("srvce"), s.get("proto")
+        # Only qnames the engine's SRV_RE would parse back to exactly
+        # this service can ever match this entry — and only LOWERCASE
+        # registrations: decoded query labels arrive lowercased
+        # (wire.py:185) and the engine compares them against the stored
+        # strings exactly, so an uppercase-registered srvce/proto is
+        # unmatchable (NXDOMAIN for every query) and must never be
+        # precompiled under its lowercased qname.
+        if not (type(srvce) is str and _SRV_LABEL_RE.match(srvce)
+                and srvce == srvce.lower()
+                and type(proto) is str and _SRV_LABEL_RE.match(proto)
+                and proto == proto.lower()):
+            return
+        default_port = s.get("port")
+        raw_members = self._zone_service_members(node, ttl)
+        if not raw_members:
+            return                      # empty set: NOERROR via Python
+        members = []
+        for knode, ksub, packed, rttl in raw_members:
+            ports = ksub.get("ports")
+            if not ports:
+                ports = [default_port]
+            if type(ports) is not list:
+                return
+            target = f"{knode.name}.{name}"
+            tw = self._qname_wire(target)
+            if tw is None:
+                return
+            ans = b""
+            for p in ports:
+                if type(p) is not int or not 0 <= p <= 0xFFFF:
+                    return              # encode would fail: decline
+                # SRV rdata: priority 0, weight 10 (engine constants),
+                # port, uncompressed target (RFC 2782 forbids pointers
+                # in SRV rdata)
+                ans += (b"\xc0\x0c\x00\x21\x00\x01"
+                        + struct.pack(">IH", ttl & 0xFFFFFFFF,
+                                      6 + len(tw))
+                        + struct.pack(">HHH", 0, 10, p) + tw)
+            add = (tw + b"\x00\x01\x00\x01"
+                   + struct.pack(">IH", rttl & 0xFFFFFFFF, 4) + packed)
+            members.append((ans, add, len(ports)))
+        qn = self._qname_wire(f"{srvce}.{proto}.{name}")
+        tag = self._qname_wire(name)
+        if qn is None or tag is None:
+            return
+        ancount = sum(m[2] for m in members)
+        arcount = len(members)
+        if ancount > 0xFFFF:
+            return
+        nv = min(len(members), 8)       # FP_MAX_VARIANTS
+        bodies = []
+        for i in range(nv):
+            rot = members[i:] + members[:i]
+            bodies.append(b"".join(m[0] for m in rot)
+                          + b"".join(m[1] for m in rot))
+        try:
+            _fastio.fastpath_zone_put(
+                self._fastpath, b"\x00\x21\x00\x01" + qn,
+                self.zk_cache.epoch, ancount, bodies, tag, arcount)
+        except (TypeError, ValueError, MemoryError) as e:
+            self.log.debug("zone SRV push skipped for %s: %s", name, e)
 
     def _zone_push_ptr(self, rev_name: str, owner) -> None:
         """Precompile the PTR answer for a reverse name (the raw lane's

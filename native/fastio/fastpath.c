@@ -262,9 +262,10 @@ fastpath_zone_put(PyObject *self, PyObject *args)
     Py_buffer zkeybuf, tagbuf;
     unsigned long long gen;
     int ancount;
+    int arcount = 0;
 
-    if (!PyArg_ParseTuple(args, "Oy*KiOy*", &capsule, &zkeybuf, &gen,
-                          &ancount, &bodies, &tagbuf))
+    if (!PyArg_ParseTuple(args, "Oy*KiOy*|i", &capsule, &zkeybuf, &gen,
+                          &ancount, &bodies, &tagbuf, &arcount))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     PyObject *fast = c != NULL
@@ -277,6 +278,7 @@ fastpath_zone_put(PyObject *self, PyObject *args)
     Py_ssize_t nv = PySequence_Fast_GET_SIZE(fast);
     int rc = 0;
     if (ancount > 0 && ancount <= 0xFFFF
+            && arcount >= 0 && arcount <= 0xFFFF
             && nv >= 1 && nv <= FP_MAX_VARIANTS) {
         const uint8_t *body_ptrs[FP_MAX_VARIANTS];
         uint16_t body_lens[FP_MAX_VARIANTS];
@@ -300,7 +302,8 @@ fastpath_zone_put(PyObject *self, PyObject *args)
         }
         if (sizes_ok)
             rc = fp_zone_put(c, zkeybuf.buf, (size_t)zkeybuf.len,
-                             (uint64_t)gen, (uint16_t)ancount, body_ptrs,
+                             (uint64_t)gen, (uint16_t)ancount,
+                             (uint16_t)arcount, body_ptrs,
                              body_lens, (int)nv,
                              (const uint8_t *)tagbuf.buf,
                              (size_t)tagbuf.len);
@@ -524,7 +527,7 @@ fastpath_stats(PyObject *self, PyObject *args)
         "bytes", (unsigned long long)c->total_bytes,
         "invalidations", (unsigned long long)c->invalidations,
         "zone_hits", (unsigned long long)c->zone_hits,
-        "zone_entries", (unsigned)c->zn_entries,
+        "zone_entries", (unsigned)(c->zmain.n + c->zalien.n),
         "zone_bytes", (unsigned long long)c->ztotal_bytes,
         "per_qtype", per);
 }

@@ -87,16 +87,32 @@ typedef struct {
     uint16_t keylen;
     uint64_t taghash;
     uint8_t has_tag;
-    uint8_t alien_tag;        /* tag != own qname: needs the scan path */
     uint64_t gen;
     uint16_t qtype;
     uint16_t ancount;
+    uint16_t arcount;         /* additionals baked into the body (SRV) */
     uint8_t n_variants;
     uint8_t next_variant;
-    uint8_t *bodies[FP_MAX_VARIANTS];     /* answer sections, c0 0c ptrs */
+    /* answer(+additional) sections; compression ptrs target offset 12 */
+    uint8_t *bodies[FP_MAX_VARIANTS];
     uint16_t body_lens[FP_MAX_VARIANTS];
     int used;
 } fp_zentry_t;
+
+/* One zone hash table (open-addressed, FP_PROBE window, grown by
+ * rehash).  There are two instances: `zmain` for entries whose
+ * dependency tag is their own qname (host A, PTR, service plain-A) —
+ * invalidated by O(1) key drops — and `zalien` for entries whose tag
+ * differs (SRV: qname _svc._proto.name, tag = the service name), which
+ * are invalidated by scanning.  Keeping the alien entries in their own
+ * small table (sized by service count, not host count) bounds that
+ * scan, which matters during mirror-build storms of tens of thousands
+ * of invalidation events. */
+typedef struct {
+    fp_zentry_t *slots;
+    uint32_t mask;            /* slot count - 1; 0 when unallocated */
+    uint32_t n;
+} fp_ztab_t;
 
 typedef struct {
     fp_entry_t *slots;
@@ -113,11 +129,8 @@ typedef struct {
     uint64_t hits;
     uint64_t lookups;
     uint64_t invalidations;   /* entries dropped by fp_invalidate_tag */
-    /* zone table (grown by rehash as the mirror fills) */
-    fp_zentry_t *zslots;
-    uint32_t zmask;
-    uint32_t zn_entries;
-    uint32_t zone_alien_tags; /* entries whose tag != own qname */
+    fp_ztab_t zmain;          /* tag == qname: O(1) invalidation */
+    fp_ztab_t zalien;         /* tag != qname: scan invalidation */
     uint64_t ztotal_bytes;
     uint64_t zone_hits;
 } fp_cache_t;
@@ -180,7 +193,7 @@ fp_core_init(fp_cache_t *c, long size, long expiry_ms)
 }
 
 static inline void
-fp_zentry_free(fp_cache_t *c, fp_zentry_t *e)
+fp_zentry_free(fp_cache_t *c, fp_ztab_t *t, fp_zentry_t *e)
 {
     for (int i = 0; i < e->n_variants; i++) {
         c->ztotal_bytes -= e->body_lens[i];
@@ -190,9 +203,18 @@ fp_zentry_free(fp_cache_t *c, fp_zentry_t *e)
     e->n_variants = 0;
     if (e->used) {
         e->used = 0;
-        c->zn_entries--;
-        if (e->alien_tag)
-            c->zone_alien_tags--;
+        t->n--;
+    }
+}
+
+static inline void
+fp_ztab_clear(fp_cache_t *c, fp_ztab_t *t)
+{
+    if (t->slots == NULL)
+        return;
+    for (uint32_t i = 0; i <= t->mask; i++) {
+        if (t->slots[i].used)
+            fp_zentry_free(c, t, &t->slots[i]);
     }
 }
 
@@ -203,12 +225,8 @@ fp_core_clear(fp_cache_t *c)
         if (c->slots[i].used)
             fp_entry_free(c, &c->slots[i]);
     }
-    if (c->zslots != NULL) {
-        for (uint32_t i = 0; i <= c->zmask; i++) {
-            if (c->zslots[i].used)
-                fp_zentry_free(c, &c->zslots[i]);
-        }
-    }
+    fp_ztab_clear(c, &c->zmain);
+    fp_ztab_clear(c, &c->zalien);
 }
 
 static inline void
@@ -219,10 +237,10 @@ fp_core_free(fp_cache_t *c)
         free(c->slots);
         c->slots = NULL;
     }
-    if (c->zslots != NULL) {
-        free(c->zslots);
-        c->zslots = NULL;
-    }
+    free(c->zmain.slots);
+    c->zmain.slots = NULL;
+    free(c->zalien.slots);
+    c->zalien.slots = NULL;
 }
 
 static inline int
@@ -363,10 +381,10 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
 #define FP_ZONE_MAX_SLOTS (1u << 24)
 #define FP_ZONE_MAX_BYTES (256u << 20)
 
-/* Grow (or create) the zone slot table so a put can always find a free
+/* Grow (or create) a zone slot table so a put can always find a free
  * probe slot at <=50% load.  Every live entry MUST stay findable
  * within the FP_PROBE lookup window — an entry displaced past it would
- * evade fp_zone_find and therefore per-name invalidation, and could
+ * evade fp_ztab_find and therefore per-name invalidation, and could
  * later serve pre-mutation answers: a silent coherence violation.  So
  * the rehash reinserts under the same bound, retries at double size
  * when a probe cluster exceeds it, and as a last resort FREES the
@@ -374,20 +392,20 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
  * their next push — slower, never stale).
  * Returns 0 ok, -1 OOM (table unchanged). */
 static inline int
-fp_zone_ensure(fp_cache_t *c)
+fp_zone_ensure(fp_cache_t *c, fp_ztab_t *t)
 {
-    if (c->zslots != NULL && c->zn_entries * 2 <= c->zmask)
+    if (t->slots != NULL && t->n * 2 <= t->mask)
         return 0;
-    uint32_t want = c->zslots == NULL ? FP_ZONE_MIN_SLOTS
-                                      : (c->zmask + 1) * 2;
+    uint32_t want = t->slots == NULL ? FP_ZONE_MIN_SLOTS
+                                     : (t->mask + 1) * 2;
 retry:
     if (want > FP_ZONE_MAX_SLOTS)
         return -1;
     fp_zentry_t *ns = (fp_zentry_t *)calloc(want, sizeof(fp_zentry_t));
     if (ns == NULL)
         return -1;
-    fp_zentry_t *old = c->zslots;
-    uint32_t old_mask = c->zmask;
+    fp_zentry_t *old = t->slots;
+    uint32_t old_mask = t->mask;
     if (old != NULL) {
         for (uint32_t i = 0; i <= old_mask; i++) {
             fp_zentry_t *e = &old[i];
@@ -396,9 +414,9 @@ retry:
             uint64_t h = fp_hash(e->key, e->keylen);
             int placed = 0;
             for (uint32_t p = 0; p < FP_PROBE; p++) {
-                fp_zentry_t *t = &ns[(h + p) & (want - 1)];
-                if (!t->used) {
-                    *t = *e;
+                fp_zentry_t *dst = &ns[(h + p) & (want - 1)];
+                if (!dst->used) {
+                    *dst = *e;
                     placed = 1;
                     break;
                 }
@@ -410,24 +428,24 @@ retry:
                     goto retry;
                 }
                 /* at the size cap: drop rather than displace */
-                fp_zentry_free(c, e);
+                fp_zentry_free(c, t, e);
             }
         }
     }
-    c->zslots = ns;
-    c->zmask = want - 1;
+    t->slots = ns;
+    t->mask = want - 1;
     free(old);
     return 0;
 }
 
 static inline fp_zentry_t *
-fp_zone_find(fp_cache_t *c, const uint8_t *zkey, size_t zklen)
+fp_ztab_find(fp_ztab_t *t, const uint8_t *zkey, size_t zklen)
 {
-    if (c->zslots == NULL)
+    if (t->slots == NULL)
         return NULL;
     uint64_t h = fp_hash(zkey, zklen);
     for (int p = 0; p < FP_PROBE; p++) {
-        fp_zentry_t *e = &c->zslots[(h + (uint64_t)p) & c->zmask];
+        fp_zentry_t *e = &t->slots[(h + (uint64_t)p) & t->mask];
         if (e->used && e->keylen == zklen &&
             memcmp(e->key, zkey, zklen) == 0)
             return e;
@@ -438,12 +456,16 @@ fp_zone_find(fp_cache_t *c, const uint8_t *zkey, size_t zklen)
 /*
  * Insert or replace a precompiled answer.  `zkey` is qtype+qclass+
  * lowercased wire qname (the dnskey minus its 3 request-dependent
- * lead bytes); bodies are finished answer sections whose compression
- * pointers target offset 12.  Returns 1 stored, 0 skipped, -1 OOM.
+ * lead bytes); bodies are finished answer(+additional) sections whose
+ * compression pointers target offset 12; `arcount` additionals (SRV
+ * target A records) are included at the tail of each body.  Routes to
+ * zmain when the tag is the entry's own qname with a directly-probed
+ * qtype/class (O(1) invalidation), zalien otherwise (scan).
+ * Returns 1 stored, 0 skipped, -1 OOM.
  */
 static inline int
 fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
-            uint64_t gen, uint16_t ancount,
+            uint64_t gen, uint16_t ancount, uint16_t arcount,
             const uint8_t *const *bodies, const uint16_t *body_lens,
             int nv, const uint8_t *tag, size_t taglen)
 {
@@ -461,13 +483,27 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
     }
     if (c->ztotal_bytes + add > FP_ZONE_MAX_BYTES)
         return 0;
-    if (fp_zone_ensure(c) < 0)
+
+    /* Table routing must be a function of the KEY alone (the serve
+     * path has only the key): (A|PTR, IN) keys live in zmain — where
+     * fp_invalidate_tag's O(1) drop rebuilds them as (qtype, IN, tag),
+     * which is only correct when the tag IS the qname, so any other
+     * tag on such a key is rejected outright — and every other key
+     * lives in the scanned (small) alien table. */
+    uint16_t zqtype = (uint16_t)((zkey[0] << 8) | zkey[1]);
+    uint16_t zqclass = (uint16_t)((zkey[2] << 8) | zkey[3]);
+    int main_table = (zqtype == 1 || zqtype == 12) && zqclass == 1;
+    if (main_table && !(taglen == zklen - 4 &&
+                        memcmp(tag, zkey + 4, taglen) == 0))
+        return 0;
+    fp_ztab_t *t = main_table ? &c->zmain : &c->zalien;
+    if (fp_zone_ensure(c, t) < 0)
         return -1;
 
     uint64_t h = fp_hash(zkey, zklen);
     fp_zentry_t *target = NULL, *stale = NULL, *oldest = NULL;
     for (int p = 0; p < FP_PROBE; p++) {
-        fp_zentry_t *e = &c->zslots[(h + (uint64_t)p) & c->zmask];
+        fp_zentry_t *e = &t->slots[(h + (uint64_t)p) & t->mask];
         if (e->used && e->keylen == zklen &&
             memcmp(e->key, zkey, zklen) == 0) {
             target = e;             /* replace in place */
@@ -486,32 +522,22 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
     if (target == NULL)
         target = stale != NULL ? stale : oldest;
     if (target->used)
-        fp_zentry_free(c, target);
+        fp_zentry_free(c, t, target);
 
     memcpy(target->key, zkey, zklen);
     target->keylen = (uint16_t)zklen;
     target->taghash = fp_hash(tag, taglen);
     target->has_tag = 1;
-    /* fp_invalidate_tag's O(1) drop rebuilds keys as (A|PTR, IN, tag):
-     * only entries matching that construction exactly may skip the scan
-     * path — tag == own qname AND a directly-probed qtype/class */
-    uint16_t zqtype = (uint16_t)((zkey[0] << 8) | zkey[1]);
-    uint16_t zqclass = (uint16_t)((zkey[2] << 8) | zkey[3]);
-    int alien = !((zqtype == 1 || zqtype == 12) && zqclass == 1 &&
-                  taglen == zklen - 4 &&
-                  memcmp(tag, zkey + 4, taglen) == 0);
-    target->alien_tag = 0;       /* set with `used` below — a mid-fill
-                                  * rollback (used still 0) must not
-                                  * leak the alien count */
     target->gen = gen;
     target->qtype = zqtype;
     target->ancount = ancount;
+    target->arcount = arcount;
     target->next_variant = 0;
     target->n_variants = 0;
     for (int i = 0; i < nv; i++) {
         uint8_t *copy = (uint8_t *)malloc((size_t)body_lens[i]);
         if (copy == NULL) {
-            fp_zentry_free(c, target);
+            fp_zentry_free(c, t, target);
             return -1;
         }
         memcpy(copy, bodies[i], (size_t)body_lens[i]);
@@ -521,10 +547,7 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
         c->ztotal_bytes += (uint64_t)body_lens[i];
     }
     target->used = 1;
-    target->alien_tag = (uint8_t)alien;
-    if (alien)
-        c->zone_alien_tags++;
-    c->zn_entries++;
+    t->n++;
     return 1;
 }
 
@@ -556,30 +579,30 @@ fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
             }
         }
     }
-    if (c->zslots != NULL && c->zn_entries > 0) {
-        if (taglen + 4 <= FP_MAX_KEY) {
-            static const uint16_t qtypes[2] = {1, 12};   /* A, PTR */
-            uint8_t zkey[FP_MAX_KEY];
-            zkey[2] = 0;
-            zkey[3] = 1;                                 /* class IN */
-            memcpy(zkey + 4, tag, taglen);
-            for (int q = 0; q < 2; q++) {
-                zkey[0] = (uint8_t)(qtypes[q] >> 8);
-                zkey[1] = (uint8_t)(qtypes[q] & 0xFF);
-                fp_zentry_t *e = fp_zone_find(c, zkey, taglen + 4);
-                if (e != NULL && e->has_tag && e->taghash == h) {
-                    fp_zentry_free(c, e);
-                    n++;
-                }
+    if (c->zmain.n > 0 && taglen + 4 <= FP_MAX_KEY) {
+        static const uint16_t qtypes[2] = {1, 12};   /* A, PTR */
+        uint8_t zkey[FP_MAX_KEY];
+        zkey[2] = 0;
+        zkey[3] = 1;                                 /* class IN */
+        memcpy(zkey + 4, tag, taglen);
+        for (int q = 0; q < 2; q++) {
+            zkey[0] = (uint8_t)(qtypes[q] >> 8);
+            zkey[1] = (uint8_t)(qtypes[q] & 0xFF);
+            fp_zentry_t *e = fp_ztab_find(&c->zmain, zkey, taglen + 4);
+            if (e != NULL && e->has_tag && e->taghash == h) {
+                fp_zentry_free(c, &c->zmain, e);
+                n++;
             }
         }
-        if (c->zone_alien_tags > 0) {
-            for (uint32_t i = 0; i <= c->zmask; i++) {
-                fp_zentry_t *e = &c->zslots[i];
-                if (e->used && e->has_tag && e->taghash == h) {
-                    fp_zentry_free(c, e);
-                    n++;
-                }
+    }
+    if (c->zalien.n > 0) {
+        /* the scan is bounded by the alien table's size (services, not
+         * hosts) — cheap even under mirror-build invalidation storms */
+        for (uint32_t i = 0; i <= c->zalien.mask; i++) {
+            fp_zentry_t *e = &c->zalien.slots[i];
+            if (e->used && e->has_tag && e->taghash == h) {
+                fp_zentry_free(c, &c->zalien, e);
+                n++;
             }
         }
     }
@@ -599,11 +622,18 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
               size_t keylen, size_t qn_len, uint64_t gen, uint8_t *out,
               uint16_t *qtype_out)
 {
-    fp_zentry_t *e = fp_zone_find(c, key + 3, keylen - 3);
+    /* table routing mirrors fp_zone_put exactly: (A|PTR, IN) keys can
+     * only live in zmain, everything else only in zalien — probing the
+     * other table would be a guaranteed miss on every lookup */
+    uint16_t zqtype = (uint16_t)((key[3] << 8) | key[4]);
+    uint16_t zqclass = (uint16_t)((key[5] << 8) | key[6]);
+    fp_ztab_t *t = ((zqtype == 1 || zqtype == 12) && zqclass == 1)
+        ? &c->zmain : &c->zalien;
+    fp_zentry_t *e = fp_ztab_find(t, key + 3, keylen - 3);
     if (e == NULL)
         return 0;
     if (e->gen != gen) {
-        fp_zentry_free(c, e);           /* lazy epoch invalidation */
+        fp_zentry_free(c, t, e);        /* lazy epoch invalidation */
         return 0;
     }
     int rd = key[0] & 1;
@@ -625,7 +655,13 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
     out[6] = (uint8_t)(e->ancount >> 8);
     out[7] = (uint8_t)(e->ancount & 0xFF);
     out[8] = 0; out[9] = 0;             /* NS=0 */
-    out[10] = 0; out[11] = (uint8_t)(edns ? 1 : 0);
+    /* additionals baked into the body, plus the OPT echo when the
+     * query carried EDNS (the OPT is appended after the body, i.e.
+     * last in the additionals section, where the generic encoder also
+     * places it) */
+    uint16_t ar = (uint16_t)(e->arcount + (edns ? 1 : 0));
+    out[10] = (uint8_t)(ar >> 8);
+    out[11] = (uint8_t)(ar & 0xFF);
     memcpy(out + 12, pkt + 12, qn_len + 4);       /* 0x20 case echo */
     memcpy(out + 12 + qn_len + 4, e->bodies[v], blen);
     if (edns)

@@ -121,10 +121,13 @@ void fuzz_one(const uint8_t *data, size_t len) {
             bodies[i] = body_store[i];
             blens[i] = (uint16_t)bl;
         }
-        /* occasionally use an alien tag to drive the zone scan path */
+        /* occasionally use an alien tag (routes to the scanned zalien
+         * table), and sometimes declare trailing additionals (SRV) */
         int alien = (len > 3 && data[3] % 7 == 0);
+        uint16_t arcount = (uint16_t)(len > 4 && data[4] % 3 == 0
+                                      ? 1 + data[4] % 2 : 0);
         int rc = fp_zone_put(fz_c, key + 3, klen - 3, fz_gen, ancount,
-                             bodies, blens, nv,
+                             arcount, bodies, blens, nv,
                              alien ? fz_alien_tag : tag,
                              alien ? sizeof(fz_alien_tag) : taglen);
         assert(rc >= 0);
@@ -143,22 +146,26 @@ void fuzz_one(const uint8_t *data, size_t len) {
                 assert(out[2] == 0x85);   /* QR|AA + RD echo (rd set) */
                 assert(out[3] == 0x00);
                 assert(dnskey_rd16(out + 6) == ancount);
-                assert(out[11] == 0);     /* no EDNS on the query */
+                /* no EDNS on the query: ar == declared additionals */
+                assert(dnskey_rd16(out + 10) == arcount);
                 assert(memcmp(out + 12, q + 12, qn_len + 4) == 0);
                 assert(memcmp(out + 12 + qn_len + 4, bodies[0],
                               blens[0]) == 0);
                 assert(got_qtype == qtype);
             }
-            /* usually KEEP the entry so the table fills and the grow/
+            /* usually KEEP the entry so the tables fill and the grow/
              * rehash path runs; every 4th, prove tag invalidation
-             * drops it through whichever path applies (direct key
-             * drop, or the scan while alien-tagged entries exist) */
+             * drops it through whichever path applies (O(1) key drop
+             * on zmain, the bounded scan on zalien) */
             if (len > 2 && data[2] % 4 == 0) {
                 uint32_t dropped = fp_invalidate_tag(
                     fz_c, alien ? fz_alien_tag : tag,
                     alien ? sizeof(fz_alien_tag) : taglen);
                 assert(dropped >= 1);
-                assert(fp_zone_find(fz_c, key + 3, klen - 3) == nullptr);
+                assert(fp_ztab_find(&fz_c->zmain, key + 3,
+                                    klen - 3) == nullptr);
+                assert(fp_ztab_find(&fz_c->zalien, key + 3,
+                                    klen - 3) == nullptr);
             }
         }
     } else {
@@ -262,18 +269,20 @@ void fuzz_one(const uint8_t *data, size_t len) {
         assert(used == fz_c->n_entries);
         assert(fz_c->hits <= fz_c->lookups);
         assert(fz_c->total_bytes <= FP_MAX_TOTAL_BYTES);
-        if (fz_c->zslots != nullptr) {
-            uint64_t zbytes = 0;
-            uint32_t zused = 0, zalien = 0;
-            for (uint32_t i = 0; i <= fz_c->zmask; i++) {
-                const fp_zentry_t *e = &fz_c->zslots[i];
+        uint64_t zbytes = 0;
+        for (fp_ztab_t *t : {&fz_c->zmain, &fz_c->zalien}) {
+            if (t->slots == nullptr) {
+                assert(t->n == 0);
+                continue;
+            }
+            uint32_t zused = 0;
+            for (uint32_t i = 0; i <= t->mask; i++) {
+                const fp_zentry_t *e = &t->slots[i];
                 if (!e->used) {
                     assert(e->n_variants == 0);
                     continue;
                 }
                 zused++;
-                if (e->alien_tag)
-                    zalien++;
                 assert(e->n_variants >= 1);
                 for (int j = 0; j < e->n_variants; j++)
                     zbytes += e->body_lens[j];
@@ -281,14 +290,13 @@ void fuzz_one(const uint8_t *data, size_t len) {
                  * window — one displaced past it (e.g. by a rehash)
                  * would evade per-name invalidation and could serve
                  * stale answers after a later rehash */
-                assert(fp_zone_find(fz_c, e->key, e->keylen) ==
+                assert(fp_ztab_find(t, e->key, e->keylen) ==
                        (fp_zentry_t *)e);
             }
-            assert(zbytes == fz_c->ztotal_bytes);
-            assert(zused == fz_c->zn_entries);
-            assert(zalien == fz_c->zone_alien_tags);
-            assert(fz_c->ztotal_bytes <= FP_ZONE_MAX_BYTES);
+            assert(zused == t->n);
         }
+        assert(zbytes == fz_c->ztotal_bytes);
+        assert(fz_c->ztotal_bytes <= FP_ZONE_MAX_BYTES);
     }
 }
 

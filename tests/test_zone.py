@@ -159,22 +159,101 @@ class TestZoneDifferential:
         asyncio.run(run())
 
     def test_shapes_the_zone_declines_are_not_zone_served(self):
-        """SRV (additionals section), missing names, and non-A qtypes go
-        through Python; the zone table must not have touched them."""
+        """Negative SRV shapes, missing names, and non-precompiled
+        qtypes go through Python; the zone table must not touch them."""
         async def run():
             _, cache = fixture_store()
             server = await start_server(cache)
             try:
-                for q in (make_query("_pg._tcp.svc.foo.com", Type.SRV,
-                                     qid=22),
-                          make_query("absent.foo.com", Type.A, qid=23),
-                          make_query("web.foo.com", Type.AAAA, qid=24)):
+                probes = (
+                    # SRV with the WRONG srvce/proto: NXDOMAIN (engine)
+                    make_query("_wrong._tcp.svc.foo.com", Type.SRV,
+                               qid=21),
+                    # SRV on a non-service name we own: NODATA + SOA
+                    make_query("_pg._tcp.web.foo.com", Type.SRV, qid=22),
+                    make_query("absent.foo.com", Type.A, qid=23),
+                    make_query("web.foo.com", Type.AAAA, qid=24),
+                )
+                for q in probes:
                     before = zone_stats(server)["zone_hits"]
                     resp = Message.decode(
                         await udp_ask_raw(server.udp_port, q.encode()))
                     assert zone_stats(server)["zone_hits"] == before, \
                         q.questions[0]
                     assert resp.id == q.id
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_srv_zone_served_content_equals_generic(self):
+        """The registered SRV qname is precompiled — answers (per member
+        per port, service TTL) and A additionals (member TTL) equal the
+        generic path's in content; EDNS queries get the OPT appended as
+        the last additional."""
+        async def run():
+            _, cache_on = fixture_store()
+            _, cache_off = fixture_store()
+            on = await start_server(cache_on)
+            off = await start_server(cache_off, zone_precompile=False)
+            try:
+                def shape(r):
+                    srv = sorted((a.name, a.ttl, a.priority, a.weight,
+                                  a.port, a.target) for a in r.answers)
+                    add = sorted((a.name, a.ttl, a.address)
+                                 for a in r.additionals
+                                 if hasattr(a, "address"))
+                    return r.rcode, srv, add
+
+                for qid, kw in ((31, {"edns_payload": None}),
+                                (32, {"edns_payload": 1400})):
+                    q = make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                                   qid=qid, **kw)
+                    before = zone_stats(on)["zone_hits"]
+                    got = Message.decode(
+                        await udp_ask_raw(on.udp_port, q.encode()))
+                    want = Message.decode(
+                        await udp_ask_raw(off.udp_port, q.encode()))
+                    assert zone_stats(on)["zone_hits"] == before + 1, kw
+                    assert shape(got) == shape(want), kw
+                    assert len(got.answers) == 2
+                    assert {a.target for a in got.answers} == \
+                        {"lb0.svc.foo.com", "lb1.svc.foo.com"}
+            finally:
+                await on.stop()
+                await off.stop()
+
+        asyncio.run(run())
+
+    def test_srv_member_mutation_repoints_through_alien_table(self):
+        """SRV entries are tagged with the service NODE name (not their
+        qname): a member mutation's parent tag must drop and re-push
+        them through the C side's alien-table scan."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                               qid=41).encode()))
+                assert len(r.answers) == 2
+                store.put_json("/com/foo/svc/lb9",
+                               {"type": "load_balancer",
+                                "load_balancer": {"address": "10.0.1.9",
+                                                  "ports": [100, 200]}})
+                await asyncio.sleep(0)
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                               qid=42).encode()))
+                assert zone_stats(server)["zone_hits"] == before + 1
+                # 2 original members (1 port each) + new member x2 ports
+                assert len(r.answers) == 4
+                ports = {a.port for a in r.answers
+                         if a.target == "lb9.svc.foo.com"}
+                assert ports == {100, 200}
             finally:
                 await server.stop()
 
