@@ -34,6 +34,10 @@ try:  # batched recvmmsg/sendmmsg datapath (built by `make -C native`)
 except ImportError:  # pure-Python fallback: recvfrom/sendto per packet
     _fastio = None
 
+# socket-free serve entry for the TCP / balancer lanes (older builds of
+# the extension predate it)
+_fp_serve_wire = getattr(_fastio, "fastpath_serve_wire", None)
+
 BALANCER_VERSION = 1
 BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
 MAX_FRAME = 65_556
@@ -275,6 +279,28 @@ class DnsServer:
                     protocol: str, send: Callable[[bytes], None],
                     client_transport: Optional[str] = None,
                     ctx_box: Optional[list] = None) -> None:
+        # Native answer-cache/zone serve for the lanes that have no C
+        # drain of their own — TCP and the balancer socket.  Direct-UDP
+        # packets reaching here already missed inside fastpath_drain, so
+        # a second lookup would be pure waste.  Correct for every lane:
+        # entries hold only untruncated responses and decline when the
+        # assembled wire would exceed the query's advertised ceiling, so
+        # a TCP serve can never differ from the Python path's.
+        if (protocol != "udp" and self.fastpath is not None
+                and _fp_serve_wire is not None
+                and (self.fastpath_gate is None or self.fastpath_gate())):
+            try:
+                resp = _fp_serve_wire(
+                    self.fastpath, data,
+                    self.fastpath_gen() if self.fastpath_gen else 0)
+            except (TypeError, ValueError):
+                resp = None
+            if resp is not None:
+                try:
+                    send(resp)
+                except OSError:
+                    pass
+                return
         lane = self.raw_lane
         if lane is not None:
             try:

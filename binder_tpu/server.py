@@ -80,6 +80,11 @@ _OPT_ECHO_WIRE = b"\x00" + struct.pack(">HHIH", 41, 1232, 0, 0)
 # qnames the engine would parse back to the same service
 _SRV_LABEL_RE = re.compile(r"^_[^_.]*$")
 
+# rotation-variant ceiling, in lockstep with FP_MAX_VARIANTS
+# (native/fastio/fpcore.h) — a push with more variants than the C side
+# accepts would be silently rejected and the name never precompiled
+_FP_MAX_VARIANTS = 8
+
 # Record types the raw lane may answer directly: exactly the host-likes
 # the resolver maps to a single A record (resolver/engine.py:213-216).
 # 'service' (rotation, SRV) and 'database' (URL parse) take the generic
@@ -534,7 +539,7 @@ class BinderServer:
         qn = self._qname_wire(name)
         if qn is None:
             return
-        nv = min(len(answers), 8)       # FP_MAX_VARIANTS
+        nv = min(len(answers), _FP_MAX_VARIANTS)
         bodies = [b"".join(answers[i:] + answers[:i]) for i in range(nv)]
         try:
             _fastio.fastpath_zone_put(
@@ -611,7 +616,7 @@ class BinderServer:
         arcount = len(members)
         if ancount > 0xFFFF:
             return
-        nv = min(len(members), 8)       # FP_MAX_VARIANTS
+        nv = min(len(members), _FP_MAX_VARIANTS)
         bodies = []
         for i in range(nv):
             rot = members[i:] + members[:i]

@@ -279,7 +279,10 @@ static PyMethodDef fastio_methods[] = {
     {"fastpath_put", fastpath_put, METH_VARARGS,
      "fastpath_put(cache, key, qtype, gen, wires) -> bool accepted"},
     {"fastpath_zone_put", fastpath_zone_put, METH_VARARGS,
-     "fastpath_zone_put(cache, zkey, gen, ancount, bodies, tag) -> bool"},
+     "fastpath_zone_put(cache, zkey, gen, ancount, bodies, tag"
+     "[, arcount]) -> bool"},
+    {"fastpath_serve_wire", fastpath_serve_wire, METH_VARARGS,
+     "fastpath_serve_wire(cache, packet, gen) -> bytes | None"},
     {"fastpath_drain", fastpath_drain, METH_VARARGS,
      "fastpath_drain(cache, fd, gen, max_n=64) -> (misses, served)"},
     {"fastpath_stats", fastpath_stats, METH_VARARGS,

@@ -319,6 +319,52 @@ fastpath_zone_put(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_serve_wire(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    Py_buffer pkt;
+    unsigned long long gen;
+
+    if (!PyArg_ParseTuple(args, "Oy*K", &capsule, &pkt, &gen))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&pkt);
+        return NULL;
+    }
+    static uint8_t out[FP_MAX_WIRE];
+    uint16_t qtype = 0;
+    double t0 = fp_now();
+    size_t wlen = fp_serve_one(c, pkt.buf, (size_t)pkt.len,
+                               (uint64_t)gen, t0, out, &qtype);
+    PyBuffer_Release(&pkt);
+    if (wlen == 0)
+        Py_RETURN_NONE;
+    if (out[2] & 0x02) {
+        /* TC responses cached off the UDP path are correct for UDP
+         * requesters but must never replay over TCP (Python answers
+         * those in full — its cache keys carry transport semantics;
+         * this entry point cannot know the transport, so it declines
+         * every truncated wire) */
+        Py_RETURN_NONE;
+    }
+    /* same per-qtype accounting as the drain path, so TCP/balancer
+     * serves land in the identical Prometheus series at fold time */
+    fp_qstat_t *qs = fp_qstat(c, qtype);
+    double elapsed = fp_now() - t0;
+    qs->count++;
+    qs->lat_sum += elapsed;
+    qs->lat_cells[fp_bucket_index(c->lat_buckets, c->n_lat_buckets,
+                                  elapsed)]++;
+    qs->size_sum += (double)wlen;
+    qs->size_cells[fp_bucket_index(c->size_buckets, c->n_size_buckets,
+                                   (double)wlen)]++;
+    return PyBytes_FromStringAndSize((const char *)out,
+                                     (Py_ssize_t)wlen);
+}
+
+PyObject *
 fastpath_invalidate(PyObject *self, PyObject *args)
 {
     (void)self;

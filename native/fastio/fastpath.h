@@ -26,6 +26,7 @@ extern unsigned char fastio_shared_bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
 PyObject *fastpath_new(PyObject *self, PyObject *args);
 PyObject *fastpath_put(PyObject *self, PyObject *args);
 PyObject *fastpath_zone_put(PyObject *self, PyObject *args);
+PyObject *fastpath_serve_wire(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);

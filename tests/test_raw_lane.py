@@ -302,9 +302,14 @@ class TestLaneBehavior:
 
     def test_balancer_protocol_lane(self):
         """Lane handles balancer-framed queries; TCP client transport
-        keys separately from UDP (truncation semantics)."""
+        keys separately from UDP (truncation semantics) in the PYTHON
+        answer cache.  The native wire-serve entry would intercept the
+        repeat before it reaches the lane (correct — fitting responses
+        are transport-identical; tests/test_zone.py covers that lane),
+        so it is detached here to exercise the Python keying."""
         _, cache = make_fixture()
         srv = new_server(cache, lane=True)
+        srv.engine.fastpath = None
         wire = make_query("web.foo.com", Type.A, qid=8).encode()
         u = ask_raw(srv, wire, protocol="balancer", client_transport="udp")
         t = ask_raw(srv, wire, protocol="balancer", client_transport="tcp")
