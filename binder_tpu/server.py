@@ -234,6 +234,7 @@ class BinderServer:
 
         self._fastpath = None
         self._fp_folded: dict = {}
+        self._fp_last_stats: dict = {}   # per-scrape snapshot (gauges)
         self._fp_fold_lock = threading.Lock()
         if (_fastio is not None and cache_size > 0
                 and hasattr(_fastio, "fastpath_new")):
@@ -262,6 +263,30 @@ class BinderServer:
             "binder_zone_serves",
             "queries answered from precompiled zone entries")
         self._zone_serve_child = self.zone_serve_counter.labelled({})
+        if self._fastpath is not None:
+            # Residency gauges: operators watching a mirror fill (or an
+            # epoch rebuild) can see the native tables converge.  All
+            # four read the single snapshot _fold_fastpath_metrics takes
+            # per scrape (it runs as a pre-expose hook) — one stats
+            # build per scrape, not one per gauge.
+            def _fp_stat(key):
+                return lambda: float(self._fp_last_stats.get(key, 0))
+            self.collector.gauge(
+                "binder_zone_entries",
+                "precompiled answers resident in the native zone tables"
+            ).set_function(_fp_stat("zone_entries"))
+            self.collector.gauge(
+                "binder_zone_bytes",
+                "bytes held by precompiled zone answer bodies"
+            ).set_function(_fp_stat("zone_bytes"))
+            self.collector.gauge(
+                "binder_fastpath_entries",
+                "entries resident in the native answer cache"
+            ).set_function(_fp_stat("entries"))
+            self.collector.gauge(
+                "binder_fastpath_bytes",
+                "bytes held by native answer-cache wires"
+            ).set_function(_fp_stat("bytes"))
 
         # actual bound ports (for tests / ephemeral binds)
         self.udp_port: Optional[int] = None
@@ -1046,6 +1071,7 @@ class BinderServer:
             # scrapes could fold in order new-then-old, regressing the
             # delta baseline and double-counting on the next fold.
             stats = _fastio.fastpath_stats(self._fastpath)
+            self._fp_last_stats = stats   # shared with residency gauges
             last = self._fp_folded
             hits_delta = stats["hits"] - last.get("hits", 0)
             if hits_delta > 0:

@@ -336,19 +336,16 @@ fastpath_serve_wire(PyObject *self, PyObject *args)
     static uint8_t out[FP_MAX_WIRE];
     uint16_t qtype = 0;
     double t0 = fp_now();
-    size_t wlen = fp_serve_one(c, pkt.buf, (size_t)pkt.len,
-                               (uint64_t)gen, t0, out, &qtype);
+    /* decline_tc: TC responses cached off the UDP path are correct for
+     * UDP requesters but must never replay over TCP (Python answers
+     * those in full — its cache keys carry transport semantics; this
+     * entry point cannot know the transport, so the core declines every
+     * truncated wire before any hit accounting) */
+    size_t wlen = fp_serve_one_ex(c, pkt.buf, (size_t)pkt.len,
+                                  (uint64_t)gen, t0, out, &qtype, 1);
     PyBuffer_Release(&pkt);
     if (wlen == 0)
         Py_RETURN_NONE;
-    if (out[2] & 0x02) {
-        /* TC responses cached off the UDP path are correct for UDP
-         * requesters but must never replay over TCP (Python answers
-         * those in full — its cache keys carry transport semantics;
-         * this entry point cannot know the transport, so it declines
-         * every truncated wire) */
-        Py_RETURN_NONE;
-    }
     /* same per-qtype accounting as the drain path, so TCP/balancer
      * serves land in the identical Prometheus series at fold time */
     fp_qstat_t *qs = fp_qstat(c, qtype);

@@ -678,10 +678,17 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
  * invalidation), variant rotation, id + 0x20 question patching.  `out`
  * must hold FP_MAX_WIRE bytes.  Returns the response length on hit, 0 on
  * miss (the caller surfaces the packet to the slow path).
+ *
+ * `decline_tc`: refuse to serve truncated cached wires — set by the
+ * socket-free entry (fastpath_serve_wire) whose callers may be TCP;
+ * the decline happens BEFORE hit accounting and rotation so refused
+ * serves neither inflate the folded cache-hit counter nor burn a
+ * rotation step.  The UDP drain passes 0 (TC wires are correct there).
  */
 static inline size_t
-fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
-             double now, uint8_t *out, uint16_t *qtype_out)
+fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
+                uint64_t gen, double now, uint8_t *out,
+                uint16_t *qtype_out, int decline_tc)
 {
     uint8_t key[FP_MAX_KEY];
     size_t qn_len = 0;
@@ -694,7 +701,8 @@ fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
     fp_entry_t *e = fp_find(c, key, keylen, gen, now);
     if (e == NULL)
         /* not in the answer cache: a precompiled zone answer still
-         * serves it natively (first query for a name included) */
+         * serves it natively (first query for a name included; zone
+         * entries are never truncated, so decline_tc is moot there) */
         return fp_zone_serve(c, pkt, key, keylen, qn_len, gen, out,
                              qtype_out);
 
@@ -702,6 +710,8 @@ fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
      * (same length by construction — key match implies identical
      * lowercased label structure) */
     uint8_t v = e->next_variant;
+    if (decline_tc && e->wire_lens[v] >= 3 && (e->wires[v][2] & 0x02))
+        return 0;
     e->next_variant = (uint8_t)((v + 1) % e->n_variants);
     const uint8_t *wire = e->wires[v];
     size_t wlen = e->wire_lens[v];
@@ -718,6 +728,14 @@ fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
         *qtype_out = e->qtype;
     c->hits++;
     return wlen;
+}
+
+/* drain-path spelling: TC wires serve (UDP requesters asked for them) */
+static inline size_t
+fp_serve_one(fp_cache_t *c, const uint8_t *pkt, size_t plen, uint64_t gen,
+             double now, uint8_t *out, uint16_t *qtype_out)
+{
+    return fp_serve_one_ex(c, pkt, plen, gen, now, out, qtype_out, 0);
 }
 
 #endif /* BINDER_FPCORE_H */

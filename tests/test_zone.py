@@ -512,6 +512,12 @@ class TestZoneCoherence:
                 text = server.collector.expose()
                 assert 'binder_zone_serves_total 3' in text.replace(
                     "binder_zone_serves 3", "binder_zone_serves_total 3")
+                # residency gauges expose the native tables' state:
+                # fixture has web + ttlhost (A), their PTRs, svc A, SRV
+                import re as _re
+                m = _re.search(r"binder_zone_entries (\d+)", text)
+                assert m and int(m.group(1)) >= 6, text[:400]
+                assert _re.search(r"binder_zone_bytes [1-9]", text)
             finally:
                 await server.stop()
 
@@ -757,6 +763,51 @@ class TestTruncationNotReplayedOverTcp:
                 await writer.wait_closed()
                 assert not t.tc
                 assert len(t.answers) == n_members, len(t.answers)
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+
+class TestZoneEpochRebuild:
+    def test_session_rebuild_repoints_zone_via_epoch(self):
+        """A (re)session rebuild bumps the mirror epoch: pre-rebuild
+        zone entries must never serve again (lazy epoch drop), and the
+        re-fired watch deliveries re-push fresh entries under the new
+        epoch — queries stay correct across the whole transition, and
+        post-rebuild serves are native again."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=11).encode()))
+                assert r.answers[0].address == "192.168.0.1"
+                old_epoch = cache.epoch
+
+                # mutate + rebuild back-to-back: the rebuild's re-fired
+                # data deliveries must repopulate with CURRENT data
+                store.put_json("/com/foo/web",
+                               {"type": "host",
+                                "host": {"address": "192.168.0.55"}})
+                cache.rebuild()
+                await asyncio.sleep(0)   # watch re-delivery (sync store)
+                assert cache.epoch == old_epoch + 1
+
+                before = zone_stats(server)["zone_hits"]
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("web.foo.com", Type.A, qid=12).encode()))
+                assert r.answers[0].address == "192.168.0.55"
+                # served natively under the NEW epoch, not via Python
+                assert zone_stats(server)["zone_hits"] == before + 1
+                # SRV (alien table) survived the transition too
+                r = Message.decode(await udp_ask_raw(
+                    server.udp_port,
+                    make_query("_pg._tcp.svc.foo.com", Type.SRV,
+                               qid=13).encode()))
+                assert r.rcode == Rcode.NOERROR and len(r.answers) == 2
             finally:
                 await server.stop()
 
