@@ -14,6 +14,7 @@ import socket as _socket
 import struct
 import threading
 import time
+from urllib.parse import urlparse as _urlparse
 from typing import Optional
 
 try:  # native fast path (built by `make -C native`); optional
@@ -438,7 +439,18 @@ class BinderServer:
         sub = record.get(rt)
         if type(sub) is not dict:
             return None
-        addr = sub.get("address")
+        tail = BinderServer._zone_a_tail(record, sub, sub.get("address"))
+        if tail is None:
+            return None
+        packed, ttl = tail
+        return record, sub, packed, ttl
+
+    @staticmethod
+    def _zone_packed_addr(addr):
+        """Canonical-dotted-quad check shared by every zone push —
+        returns the packed address, or None to decline to Python.  ONE
+        copy, so the rule cannot drift between the host, database, and
+        service member paths."""
         if type(addr) is not str:
             return None
         try:
@@ -447,17 +459,53 @@ class BinderServer:
             return None
         if _socket.inet_ntoa(packed) != addr:
             return None
+        return packed
+
+    @staticmethod
+    def _zone_a_tail(record, sub, addr):
+        """Validation tail for the single-A shapes (host-likes,
+        database): canonical address + int TTL, or decline."""
+        packed = BinderServer._zone_packed_addr(addr)
+        if packed is None:
+            return None
         ttl = _lane_ttl(record, sub)
         if ttl is None:
             return None
+        return packed, ttl
+
+    @staticmethod
+    def _zone_database_shape(record):
+        """The database branch of engine.resolve — one A record whose
+        address is the hostname of the ``primary`` URL
+        (lib/server.js:295-305) — when it would encode cleanly, else
+        None (non-IP hostnames and malformed URLs stay in Python)."""
+        sub = record.get("database")
+        if type(sub) is not dict:
+            return None
+        primary = sub.get("primary", "")
+        if type(primary) is not str:
+            return None                 # urlparse(non-str) raises
+        try:
+            addr = _urlparse(primary).hostname
+        except ValueError:
+            return None
+        tail = BinderServer._zone_a_tail(record, sub, addr)
+        if tail is None:
+            return None
+        packed, ttl = tail
         return record, sub, packed, ttl
 
     def _zone_push_a(self, name: str, node) -> None:
-        """Precompile the A answer for a host record (the raw lane's A
-        branch, done once at mutation time instead of per query)."""
+        """Precompile the A answer for a host-like or database record
+        (the raw lane's A branch plus engine.resolve's database branch,
+        done once at mutation time instead of per query)."""
         if not self._zone_suffix_ok(name):
             return
-        shape = self._zone_host_shape(node)
+        record = node.data
+        if (type(record) is dict and record.get("type") == "database"):
+            shape = self._zone_database_shape(record)
+        else:
+            shape = self._zone_host_shape(node)
         if shape is None:
             return
         _record, _sub, packed, ttl = shape
@@ -524,14 +572,9 @@ class BinderServer:
             addr = ksub.get("address")
             if addr is None:
                 continue                # engine skips addressless kids
-            if type(addr) is not str:
-                return None
-            try:
-                packed = _socket.inet_aton(addr)
-            except (OSError, TypeError):
+            packed = self._zone_packed_addr(addr)
+            if packed is None:
                 return None             # encode would fail: decline
-            if _socket.inet_ntoa(packed) != addr:
-                return None
             rttl = _engine_record_ttl(krec, ksub, ttl)
             if type(rttl) is not int:
                 return None

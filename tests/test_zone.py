@@ -812,3 +812,84 @@ class TestZoneEpochRebuild:
                 await server.stop()
 
         asyncio.run(run())
+
+
+class TestZoneDatabaseAndBalancerLane:
+    def test_database_record_zone_served_differentially(self):
+        """Database records (A from the primary URL's hostname,
+        engine.resolve's database branch) precompile when the hostname
+        is a canonical IPv4; a hostname that is NOT an address stays in
+        Python (whatever it does there, the zone must not differ)."""
+        async def run():
+            def stores():
+                store = FakeStore()
+                cache = MirrorCache(store, DOMAIN)
+                store.put_json("/com/foo/pg", {
+                    "type": "database", "ttl": 45,
+                    "database": {"primary":
+                                 "tcp://10.4.4.4:5432/moray"}})
+                store.put_json("/com/foo/pgname", {
+                    "type": "database",
+                    "database": {"primary":
+                                 "tcp://pg.example.net:5432/moray"}})
+                # non-string primary: must decline quietly, not
+                # traceback through the mutation path (urlparse raises
+                # AttributeError on non-str)
+                store.put_json("/com/foo/pgbad", {
+                    "type": "database", "database": {"primary": 45}})
+                store.start_session()
+                return cache
+
+            on = await start_server(stores())
+            off = await start_server(stores(), zone_precompile=False)
+            try:
+                wire = make_query("pg.foo.com", Type.A, qid=51).encode()
+                before = zone_stats(on)["zone_hits"]
+                got = await udp_ask_raw(on.udp_port, wire)
+                want = await udp_ask_raw(off.udp_port, wire)
+                assert got == want
+                assert zone_stats(on)["zone_hits"] == before + 1
+                r = Message.decode(got)
+                assert r.answers[0].address == "10.4.4.4"
+                assert r.answers[0].ttl == 45
+
+                # non-IP primary hostname and non-string primary: never
+                # precompiled; responses still agree with the generic
+                # path
+                for qid, name in ((52, "pgname.foo.com"),
+                                  (53, "pgbad.foo.com")):
+                    wire = make_query(name, Type.A, qid=qid).encode()
+                    before = zone_stats(on)["zone_hits"]
+                    got = await udp_ask_raw(on.udp_port, wire)
+                    want = await udp_ask_raw(off.udp_port, wire)
+                    assert got == want, name
+                    assert zone_stats(on)["zone_hits"] == before, name
+            finally:
+                await on.stop()
+                await off.stop()
+
+        asyncio.run(run())
+
+    def test_balancer_lane_zone_served(self):
+        """Queries arriving over the balancer socket protocol (a
+        balancer-fronted backend's only lane) are zone-served through
+        the wire entry point without touching the Python resolver."""
+        async def run():
+            _, cache = fixture_store()
+            server = await start_server(cache)
+            try:
+                out = []
+                wire = make_query("web.foo.com", Type.A, qid=61).encode()
+                before = zone_stats(server)["zone_hits"]
+                server.engine._handle_raw(
+                    wire, ("10.0.0.9", 5353), "balancer", out.append,
+                    client_transport="udp")
+                assert out, "no response emitted"
+                assert zone_stats(server)["zone_hits"] == before + 1
+                r = Message.decode(out[0])
+                assert r.id == 61
+                assert r.answers[0].address == "192.168.0.1"
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
