@@ -439,11 +439,7 @@ class BinderServer:
         sub = record.get(rt)
         if type(sub) is not dict:
             return None
-        tail = BinderServer._zone_a_tail(record, sub, sub.get("address"))
-        if tail is None:
-            return None
-        packed, ttl = tail
-        return record, sub, packed, ttl
+        return BinderServer._zone_a_tail(record, sub, sub.get("address"))
 
     @staticmethod
     def _zone_packed_addr(addr):
@@ -464,14 +460,16 @@ class BinderServer:
     @staticmethod
     def _zone_a_tail(record, sub, addr):
         """Validation tail for the single-A shapes (host-likes,
-        database): canonical address + int TTL, or decline."""
+        database): canonical address + int TTL, or decline.  Returns
+        the full (record, sub, packed, ttl) shape so callers are a
+        single return."""
         packed = BinderServer._zone_packed_addr(addr)
         if packed is None:
             return None
         ttl = _lane_ttl(record, sub)
         if ttl is None:
             return None
-        return packed, ttl
+        return record, sub, packed, ttl
 
     @staticmethod
     def _zone_database_shape(record):
@@ -489,11 +487,7 @@ class BinderServer:
             addr = _urlparse(primary).hostname
         except ValueError:
             return None
-        tail = BinderServer._zone_a_tail(record, sub, addr)
-        if tail is None:
-            return None
-        packed, ttl = tail
-        return record, sub, packed, ttl
+        return BinderServer._zone_a_tail(record, sub, addr)
 
     def _zone_push_a(self, name: str, node) -> None:
         """Precompile the A answer for a host-like or database record

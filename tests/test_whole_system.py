@@ -50,7 +50,14 @@ async def udp_ask(port, name, qtype, qid):
     return await _udp_ask(port, name, qtype, qid=qid, rd=True)
 
 
-def test_everything_at_once(tmp_path):
+# both serving postures: query_log=True keeps every query in Python
+# (generic path); False engages the full native stack — raw lane,
+# fastpath cache, zone precompilation, serve_wire on the balancer lane —
+# so the SAME fault scenario (ZK member death, backend death, churn)
+# also exercises native-path coherence end to end
+@pytest.mark.parametrize("query_log", [True, False],
+                         ids=["python-path", "native-path"])
+def test_everything_at_once(tmp_path, query_log):
     sockdir = str(tmp_path)
 
     async def run():
@@ -82,7 +89,8 @@ def test_everything_at_once(tmp_path):
         rstore.start_session()
         remote = BinderServer(zk_cache=rcache, dns_domain=DOMAIN,
                               datacenter_name="east", host="127.0.0.1",
-                              port=0, collector=MetricsCollector())
+                              port=0, collector=MetricsCollector(),
+                              query_log=query_log)
         await remote.start()
 
         # -- 2 ZK-backed backends with recursion, behind the balancer --
@@ -105,7 +113,7 @@ def test_everything_at_once(tmp_path):
                 datacenter_name="local", recursion=recursion,
                 host="127.0.0.1", port=0,
                 balancer_socket=os.path.join(sockdir, str(i)),
-                collector=MetricsCollector())
+                collector=MetricsCollector(), query_log=query_log)
             await server.start()
             backends.append((client, cache, recursion, server))
         assert await wait_for(lambda: all(
