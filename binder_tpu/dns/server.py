@@ -394,6 +394,25 @@ class DnsServer:
         self.log.info("UDP DNS service started on %s:%d", address, actual)
         return actual
 
+    def close_udp_listener(self, port: int) -> None:
+        """Tear down one bound UDP listener.  Used by the paired-bind
+        retry in ``BinderServer.start``: with ``port=0`` the kernel
+        picks the UDP port first, and when that number turns out to be
+        occupied on TCP the draw must be released and repeated."""
+        for i, (loop, sock) in enumerate(self._udp_socks):
+            try:
+                bound = sock.getsockname()[1]
+            except OSError:
+                continue
+            if bound == port:
+                try:
+                    loop.remove_reader(sock.fileno())
+                except (OSError, ValueError):
+                    pass
+                sock.close()
+                del self._udp_socks[i]
+                return
+
     def _batched_udp_reader(self, sock: socket.socket) -> Callable[[], None]:
         """recvmmsg/sendmmsg datapath (native/fastio/fastio.c).
 

@@ -8,6 +8,7 @@ socket (``lib/server.js:609-653``).
 """
 from __future__ import annotations
 
+import errno as _errno
 import logging
 import re
 import socket as _socket
@@ -1216,13 +1217,44 @@ class BinderServer:
 
     # -- lifecycle (lib/server.js:609-657) --
 
+    #: ephemeral pair-bind redraws before giving up; each failure means
+    #: the kernel-chosen UDP port was taken on TCP, so consecutive
+    #: failures are near-independent draws from the ephemeral range
+    _PAIR_BIND_ATTEMPTS = 16
+
     async def start(self) -> None:
         self._zone_fill()
         if self.balancer_socket:
             await self.engine.listen_balancer(self.balancer_socket)
-        self.udp_port = await self.engine.listen_udp(self.host, self.port)
-        self.tcp_port = await self.engine.listen_tcp(
-            self.host, self.port if self.port else self.udp_port)
+        # UDP and TCP must share one port number (the reference serves
+        # both on the same port, lib/server.js:643-653).  With port=0
+        # the kernel picks the UDP port and any unrelated socket may
+        # already hold that number on TCP — so the pair bind is a retry
+        # loop: release the UDP draw and redraw instead of failing
+        # (the observed CI flake: EADDRINUSE on the UDP-chosen port).
+        for attempt in range(self._PAIR_BIND_ATTEMPTS):
+            udp_port = await self.engine.listen_udp(self.host, self.port)
+            try:
+                self.tcp_port = await self.engine.listen_tcp(
+                    self.host, self.port if self.port else udp_port)
+            except OSError as e:
+                # the failed draw must be released even when re-raising:
+                # callers treat start() as atomic and won't stop() a
+                # server that never started
+                self.engine.close_udp_listener(udp_port)
+                # errno is None when asyncio aggregates several bind
+                # failures (multi-address hosts) into one OSError — a
+                # colliding draw must redraw in that shape too
+                if (self.port == 0
+                        and e.errno in (_errno.EADDRINUSE, None)
+                        and attempt < self._PAIR_BIND_ATTEMPTS - 1):
+                    continue
+                # failed for good: release the balancer listener opened
+                # above so the raise leaves no socket behind
+                await self.engine.close()
+                raise
+            self.udp_port = udp_port
+            break
 
     async def stop(self) -> None:
         await self.engine.close()

@@ -534,3 +534,97 @@ class TestTcpBounds:
         aborted, r = asyncio.run(run())
         assert aborted
         assert r.rcode == Rcode.NOERROR
+
+
+class TestPairBind:
+    """Ephemeral-port UDP/TCP pairing (the r4 CI flake): with port=0 the
+    kernel picks the UDP port and TCP must bind the same number, which
+    any unrelated socket may hold — start() must redraw, not die."""
+
+    def test_tcp_collision_redraws(self):
+        async def run():
+            store, cache = fixture_store()
+            # occupy a TCP port the first UDP draw will be forced onto
+            blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            blocker.bind(("127.0.0.1", 0))
+            blocker.listen(1)
+            taken = blocker.getsockname()[1]
+
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="coal",
+                                  host="127.0.0.1", port=0,
+                                  collector=MetricsCollector())
+            real_listen_udp = server.engine.listen_udp
+            calls = []
+
+            async def forced_listen_udp(host, port):
+                # first draw lands on the TCP-occupied port (what the
+                # kernel did to CI); later draws are honest
+                calls.append(port)
+                if len(calls) == 1:
+                    return await real_listen_udp(host, taken)
+                return await real_listen_udp(host, port)
+
+            server.engine.listen_udp = forced_listen_udp
+            await server.start()
+            try:
+                assert len(calls) >= 2          # it retried
+                assert server.udp_port == server.tcp_port != taken
+                # the failed draw was released: only ONE UDP listener
+                assert len(server.engine._udp_socks) == 1
+                r = await udp_ask(server.udp_port, "web.foo.com", Type.A)
+                assert r.rcode == Rcode.NOERROR
+                r = await tcp_ask(server.tcp_port, "web.foo.com", Type.A)
+                assert r.rcode == Rcode.NOERROR
+            finally:
+                blocker.close()
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_fixed_port_collision_raises(self):
+        """A FIXED port that is TCP-occupied is a real error: no silent
+        redraw to a different number, and the UDP draw is released."""
+        async def run():
+            store, cache = fixture_store()
+            blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            blocker.bind(("127.0.0.1", 0))
+            blocker.listen(1)
+            taken = blocker.getsockname()[1]
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="coal",
+                                  host="127.0.0.1", port=taken,
+                                  collector=MetricsCollector())
+            try:
+                await server.start()
+            except OSError:
+                assert server.engine._udp_socks == []
+                return True
+            finally:
+                blocker.close()
+                await server.stop()
+            return False
+
+        assert asyncio.run(run())
+
+    def test_concurrent_ephemeral_startups(self):
+        """Hammer: many port=0 servers starting concurrently while TCP
+        churn occupies ephemeral ports.  Every start must succeed with
+        udp_port == tcp_port (probabilistic companion to the
+        deterministic collision test above)."""
+        async def run():
+            store, cache = fixture_store()
+
+            async def one():
+                s = await start_server(cache)
+                assert s.udp_port == s.tcp_port
+                return s
+
+            for _ in range(4):
+                servers = await asyncio.gather(*[one() for _ in range(8)])
+                for s in servers:
+                    r = await udp_ask(s.udp_port, "web.foo.com", Type.A)
+                    assert r.rcode == Rcode.NOERROR
+                    await s.stop()
+
+        asyncio.run(run())
