@@ -155,6 +155,10 @@ class DnsServer:
         self.fastpath = None
         self.fastpath_gen: Optional[Callable[[], int]] = None
         self.fastpath_gate: Optional[Callable[[], bool]] = None
+        # Drains the native query-log ring (installed by BinderServer in
+        # the logged posture); called once per UDP drain pass so ring
+        # writes amortize over a whole batch of serves.
+        self.fastpath_log_flush: Optional[Callable[[], None]] = None
         # Balancer answer-cache support: control frames let the balancer
         # cache responses with backend-driven invalidation.
         # `gen_source` supplies the current generation/epoch;
@@ -290,9 +294,17 @@ class DnsServer:
                 and _fp_serve_wire is not None
                 and (self.fastpath_gate is None or self.fastpath_gate())):
             try:
-                resp = _fp_serve_wire(
-                    self.fastpath, data,
-                    self.fastpath_gen() if self.fastpath_gen else 0)
+                gen = self.fastpath_gen() if self.fastpath_gen else 0
+                # src/protocol ride along so the logged posture can emit
+                # this serve's log line from inside the C core; passed
+                # ONLY when the ring is armed so an older compiled
+                # extension (3-arg serve_wire) keeps working in the
+                # log-off posture instead of TypeError-ing per query
+                if self.fastpath_log_flush is not None:
+                    resp = _fp_serve_wire(self.fastpath, data, gen,
+                                          src[0], src[1], protocol)
+                else:
+                    resp = _fp_serve_wire(self.fastpath, data, gen)
             except (TypeError, ValueError):
                 resp = None
             if resp is not None:
@@ -500,6 +512,12 @@ class DnsServer:
                                           len(out) - sent)
                     except OSError as e:
                         log.error("batched UDP send failed: %s", e)
+                log_flush = self.fastpath_log_flush
+                if use_fp and log_flush is not None:
+                    try:
+                        log_flush()
+                    except Exception:
+                        log.exception("query-log ring drain failed")
 
         return on_readable
 

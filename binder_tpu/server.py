@@ -8,8 +8,11 @@ socket (``lib/server.js:609-653``).
 """
 from __future__ import annotations
 
+import asyncio
 import errno as _errno
+import json as _json
 import logging
+import os as _os
 import re
 import socket as _socket
 import struct
@@ -48,7 +51,7 @@ from binder_tpu.resolver.engine import (
     SERVICE_CHILD_TYPES as _SERVICE_CHILD_TYPES,
     _record_ttl as _engine_record_ttl,
 )
-from binder_tpu.utils.jsonlog import log_event
+from binder_tpu.utils.jsonlog import JsonFormatter, log_event
 from binder_tpu.utils.probes import ProbeProvider
 
 METRIC_REQUEST_COUNTER = "binder_requests_completed"
@@ -248,6 +251,37 @@ class BinderServer:
             self.engine.fastpath_gen = lambda: self.zk_cache.epoch
             self.engine.fastpath_gate = self._fastpath_active
             self.collector.on_expose(self._fold_fastpath_metrics)
+
+        # Native query-log ring: with per-query logging ON (the
+        # reference's always-on posture, lib/server.js:537-591) the fast
+        # path previously stood down completely, forfeiting ~9x
+        # throughput.  Instead, entries now carry pre-rendered JSON log
+        # fragments, the C serve path appends one complete bunyan-style
+        # line per serve to a byte ring, and Python drains the ring in
+        # batches onto the SAME stream the JSON logger writes to — one
+        # stream write per batch instead of one formatting pass per
+        # query.  A serve that cannot produce its line (ring full, no
+        # fragment) DECLINES to the Python path, which logs normally:
+        # pressure degrades throughput, never drops log records.
+        # Armed only when the server's logger actually ends in a
+        # JsonFormatter stream (the production logger from make_logger);
+        # otherwise the old stand-down gating applies unchanged.
+        self._log_ring = False
+        self._log_json_handlers: list = []
+        self._log_flush_task: Optional[asyncio.Task] = None
+        if (self.query_log and self._fastpath is not None
+                and hasattr(_fastio, "fastpath_log_enable")
+                and self.log.isEnabledFor(logging.INFO)):
+            self._log_json_handlers = self._find_json_handlers()
+            if self._log_json_handlers:
+                try:
+                    _fastio.fastpath_log_enable(
+                        self._fastpath, self._native_log_prefix(),
+                        1 << 20)
+                    self._log_ring = True
+                    self.engine.fastpath_log_flush = self._drain_native_log
+                except ValueError:
+                    self._log_json_handlers = []
 
         # Zone precompilation (fpcore.h zone table): finished answer
         # bodies for the dominant record shapes (host A, PTR) are pushed
@@ -509,10 +543,21 @@ class BinderServer:
             return
         body = (b"\xc0\x0c\x00\x01\x00\x01"
                 + struct.pack(">IH", ttl & 0xFFFFFFFF, 4) + packed)
+        frags = None
+        if self._log_ring:
+            # zone serves replace what Python would resolve fresh —
+            # the fragment mirrors the resolve-path log line
+            addr = _socket.inet_ntoa(packed)
+            frags = [self._log_frag(
+                {"query": {"srv": None, "name": name, "type": "A"}},
+                Rcode.NOERROR,
+                [self._summarize(ARecord(name=name, ttl=ttl,
+                                         address=addr))], [])]
+            if frags[0] is None:
+                return
         try:
-            _fastio.fastpath_zone_put(
-                self._fastpath, b"\x00\x01\x00\x01" + qn,
-                self.zk_cache.epoch, 1, [body], qn)
+            self._zone_put(b"\x00\x01\x00\x01" + qn, 1, [body], qn,
+                           0, frags)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone A push skipped for %s: %s", name, e)
 
@@ -604,10 +649,22 @@ class BinderServer:
             return
         nv = min(len(answers), _FP_MAX_VARIANTS)
         bodies = [b"".join(answers[i:] + answers[:i]) for i in range(nv)]
+        frags = None
+        if self._log_ring:
+            # per-variant summaries rotate in lockstep with the bodies
+            sums = [self._summarize(ARecord(
+                        name=name, ttl=min(ttl, rttl),
+                        address=_socket.inet_ntoa(packed)))
+                    for _knode, _ksub, packed, rttl in members]
+            ctx = {"query": {"srv": None, "name": name, "type": "A"}}
+            frags = [self._log_frag(ctx, Rcode.NOERROR,
+                                    sums[i:] + sums[:i], [])
+                     for i in range(nv)]
+            if any(f is None for f in frags):
+                return
         try:
-            _fastio.fastpath_zone_put(
-                self._fastpath, b"\x00\x01\x00\x01" + qn,
-                self.zk_cache.epoch, len(answers), bodies, qn)
+            self._zone_put(b"\x00\x01\x00\x01" + qn, len(answers),
+                           bodies, qn, 0, frags)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone service push skipped for %s: %s",
                            name, e)
@@ -658,6 +715,7 @@ class BinderServer:
             if tw is None:
                 return
             ans = b""
+            srv_sums = []
             for p in ports:
                 if type(p) is not int or not 0 <= p <= 0xFFFF:
                     return              # encode would fail: decline
@@ -668,9 +726,19 @@ class BinderServer:
                         + struct.pack(">IH", ttl & 0xFFFFFFFF,
                                       6 + len(tw))
                         + struct.pack(">HHH", 0, 10, p) + tw)
+                if self._log_ring:
+                    srv_sums.append(self._summarize(SRVRecord(
+                        name=name, ttl=ttl, priority=0, weight=10,
+                        port=p, target=target)))
+            # summaries rendered only in the logged posture — churn-path
+            # zone refreshes in the log-off posture must not pay for them
+            add_sum = (self._summarize(ARecord(
+                name=target, ttl=rttl,
+                address=_socket.inet_ntoa(packed)))
+                if self._log_ring else None)
             add = (tw + b"\x00\x01\x00\x01"
                    + struct.pack(">IH", rttl & 0xFFFFFFFF, 4) + packed)
-            members.append((ans, add, len(ports)))
+            members.append((ans, add, len(ports), srv_sums, add_sum))
         qn = self._qname_wire(f"{srvce}.{proto}.{name}")
         tag = self._qname_wire(name)
         if qn is None or tag is None:
@@ -685,10 +753,22 @@ class BinderServer:
             rot = members[i:] + members[:i]
             bodies.append(b"".join(m[0] for m in rot)
                           + b"".join(m[1] for m in rot))
+        frags = None
+        if self._log_ring:
+            ctx = {"query": {"srv": f"{srvce}.{proto}", "name": name,
+                             "type": "SRV"}}
+            frags = []
+            for i in range(nv):
+                rot = members[i:] + members[:i]
+                frags.append(self._log_frag(
+                    ctx, Rcode.NOERROR,
+                    [s for m in rot for s in m[3]],
+                    [m[4] for m in rot]))
+            if any(f is None for f in frags):
+                return
         try:
-            _fastio.fastpath_zone_put(
-                self._fastpath, b"\x00\x21\x00\x01" + qn,
-                self.zk_cache.epoch, ancount, bodies, tag, arcount)
+            self._zone_put(b"\x00\x21\x00\x01" + qn, ancount, bodies,
+                           tag, arcount, frags)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone SRV push skipped for %s: %s", name, e)
 
@@ -711,12 +791,34 @@ class BinderServer:
             return
         body = (b"\xc0\x0c\x00\x0c\x00\x01"
                 + struct.pack(">IH", ttl & 0xFFFFFFFF, len(tw)) + tw)
+        frags = None
+        if self._log_ring:
+            ip = ".".join(reversed(rev_name.split(".")[:-2]))
+            frags = [self._log_frag(
+                {"query": {"ip": ip, "type": "PTR"}}, Rcode.NOERROR,
+                [self._summarize(PTRRecord(name=rev_name, ttl=ttl,
+                                           target=target))], [])]
+            if frags[0] is None:
+                return
         try:
-            _fastio.fastpath_zone_put(
-                self._fastpath, b"\x00\x0c\x00\x01" + qn,
-                self.zk_cache.epoch, 1, [body], qn)
+            self._zone_put(b"\x00\x0c\x00\x01" + qn, 1, [body], qn,
+                           0, frags)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("zone PTR push skipped for %s: %s", rev_name, e)
+
+    def _zone_put(self, zkey: bytes, ancount: int, bodies, tag: bytes,
+                  arcount: int, frags) -> None:
+        """The one zone_put call site: appends the per-variant log
+        fragments only when present, so an older compiled extension
+        (pre-log-ring arity) keeps accepting log-off pushes."""
+        if frags is not None:
+            _fastio.fastpath_zone_put(self._fastpath, zkey,
+                                      self.zk_cache.epoch, ancount,
+                                      bodies, tag, arcount, frags)
+        else:
+            _fastio.fastpath_zone_put(self._fastpath, zkey,
+                                      self.zk_cache.epoch, ancount,
+                                      bodies, tag, arcount)
 
     def _zone_fill(self) -> None:
         """Walk the mirror and push every eligible precompiled answer —
@@ -751,12 +853,28 @@ class BinderServer:
         if not variants:
             return
         wires = [v[0] for v in variants]
+        frags = None
+        if self._log_ring:
+            # native serves of this entry are cache hits; the Python
+            # hit path logs exactly {cached: true} + rcode + summaries
+            # (_on_query cache-hit branch + _on_after), so the fragment
+            # mirrors that shape per variant
+            frags = [self._log_frag({"cached": True}, w[3] & 0x0F, a, d)
+                     for (w, a, d) in variants]
+            if any(f is None for f in frags):
+                return                  # unloggable: stays in Python
         ttl_ms = self.answer_cache.remaining_ttl_ms(key, epoch)
+        ttl_arg = -1 if ttl_ms is None else int(ttl_ms)
         try:
-            _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
-                                 epoch, wires,
-                                 -1 if ttl_ms is None else int(ttl_ms),
-                                 tag_wire)
+            # frags appended only when present so an older compiled
+            # extension keeps accepting log-off pushes
+            if frags is not None:
+                _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
+                                     epoch, wires, ttl_arg, tag_wire,
+                                     frags)
+            else:
+                _fastio.fastpath_put(self._fastpath, ckey, query.qtype(),
+                                     epoch, wires, ttl_arg, tag_wire)
         except (TypeError, ValueError, MemoryError) as e:
             self.log.debug("fastpath push skipped: %s", e)
 
@@ -1153,11 +1271,89 @@ class BinderServer:
 
     def _fastpath_active(self) -> bool:
         """The C path bypasses Python entirely, so it must stand down
-        whenever every query has to surface: per-query logging on, or a
-        probe consumer attached."""
-        return (not self.query_log
-                and not self.p_req_start.enabled
-                and not self.p_req_done.enabled)
+        whenever every query has to surface: a probe consumer attached,
+        or per-query logging on WITHOUT the native log ring (with the
+        ring armed, the C path produces the log lines itself)."""
+        return (not self.p_req_start.enabled
+                and not self.p_req_done.enabled
+                and (not self.query_log or self._log_ring))
+
+    # -- native query-log ring plumbing --
+
+    def _find_json_handlers(self) -> list:
+        """StreamHandlers with a JsonFormatter reachable from this
+        server's logger (walking propagation like logging does) — the
+        sinks the ring's pre-formatted lines are written to."""
+        handlers = []
+        lg: Optional[logging.Logger] = self.log
+        while lg is not None:
+            for h in lg.handlers:
+                if (isinstance(h, logging.StreamHandler)
+                        and isinstance(h.formatter, JsonFormatter)
+                        and h.level <= logging.INFO):
+                    handlers.append(h)
+            if not lg.propagate:
+                break
+            lg = lg.parent
+        return handlers
+
+    def _native_log_prefix(self) -> bytes:
+        """Constant head of every native log line, up to and including
+        ``"time": "`` — rendered once from the logger's identity, so
+        ring lines carry the same envelope as JsonFormatter's."""
+        fmt = self._log_json_handlers[0].formatter
+        head = {"name": fmt.name, "hostname": fmt.hostname,
+                "pid": _os.getpid(), "level": 30,
+                "component": self.log.name, "msg": "DNS query"}
+        return (_json.dumps(head)[:-1] + ', "time": "').encode()
+
+    @staticmethod
+    def _log_frag(ctx: dict, rcode: int, ans, add) -> Optional[bytes]:
+        """Pre-rendered middle of a log line (the answer-dependent
+        fields) for one entry variant; None when it cannot be rendered
+        or would exceed the native bound (the entry then declines to
+        Python under logging, which is always correct)."""
+        d = dict(ctx)
+        d["rcode"] = Rcode.name(rcode)
+        d["answers"] = ans
+        d["additional"] = add
+        try:
+            frag = _json.dumps(d, default=str)[1:-1].encode()
+        except (TypeError, ValueError):
+            return None
+        return frag if 0 < len(frag) <= 4096 else None
+
+    def _drain_native_log(self) -> None:
+        """Write the ring's accumulated complete lines to the JSON log
+        stream(s).  Called from the UDP drain loop (amortized over each
+        batch) and from a periodic flusher covering the TCP/balancer
+        lanes and idle tails."""
+        try:
+            block = _fastio.fastpath_log_drain(self._fastpath)
+        except (TypeError, ValueError):
+            return
+        if not block:
+            return
+        text = block.decode("utf-8", "replace")
+        for h in self._log_json_handlers:
+            try:
+                h.acquire()
+                try:
+                    h.stream.write(text)
+                    h.flush()
+                finally:
+                    h.release()
+            except Exception:
+                pass   # a dead log sink must never take down serving
+
+    async def _log_flush_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(0.1)
+                self._drain_native_log()
+        except asyncio.CancelledError:
+            self._drain_native_log()
+            raise
 
     # -- after hook: metrics + query log (lib/server.js:509-591) --
 
@@ -1255,8 +1451,22 @@ class BinderServer:
                 raise
             self.udp_port = udp_port
             break
+        if self._log_ring and self._log_flush_task is None:
+            # periodic drain for the lanes without a C drain loop of
+            # their own (TCP/balancer serves) and for idle tails
+            self._log_flush_task = asyncio.get_running_loop().create_task(
+                self._log_flush_loop())
 
     async def stop(self) -> None:
+        if self._log_flush_task is not None:
+            self._log_flush_task.cancel()
+            try:
+                await self._log_flush_task
+            except asyncio.CancelledError:
+                pass
+            self._log_flush_task = None
+        if self._log_ring:
+            self._drain_native_log()
         await self.engine.close()
 
 

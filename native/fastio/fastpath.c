@@ -48,6 +48,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <arpa/inet.h>
 #include <errno.h>
 #include <netinet/in.h>
 #include <stdint.h>
@@ -178,11 +179,50 @@ fastpath_new(PyObject *self, PyObject *args)
     return capsule;
 }
 
+/* Borrow (ptr, len) arrays for a per-variant fragment sequence.  On
+ * success *fast_out holds the sequence keeping the pointers alive and
+ * frag_ptrs/frag_lens are filled for exactly `expect` items.  Returns
+ * 1 usable, 0 skip-the-put (wrong count / oversize / empty), -1 with a
+ * Python exception set. */
+static int
+fp_load_frags(PyObject *frags, Py_ssize_t expect, PyObject **fast_out,
+              const uint8_t **frag_ptrs, uint16_t *frag_lens)
+{
+    *fast_out = NULL;
+    if (frags == NULL || frags == Py_None)
+        return 1;                   /* no fragments: log-off posture */
+    PyObject *fast = PySequence_Fast(frags, "frags must be a sequence");
+    if (fast == NULL)
+        return -1;
+    if (PySequence_Fast_GET_SIZE(fast) != expect) {
+        Py_DECREF(fast);
+        return 0;                   /* per-variant mismatch: skip */
+    }
+    for (Py_ssize_t i = 0; i < expect; i++) {
+        char *data;
+        Py_ssize_t dlen;
+        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                    &data, &dlen) < 0) {
+            Py_DECREF(fast);
+            return -1;
+        }
+        if (dlen < 1 || dlen > FP_MAX_FRAG) {
+            Py_DECREF(fast);
+            return 0;               /* unloggable: stays in Python */
+        }
+        frag_ptrs[i] = (const uint8_t *)data;
+        frag_lens[i] = (uint16_t)dlen;
+    }
+    *fast_out = fast;
+    return 1;
+}
+
 PyObject *
 fastpath_put(PyObject *self, PyObject *args)
 {
     (void)self;
     PyObject *capsule, *wires;
+    PyObject *frags = NULL;
     Py_buffer keybuf, tagbuf;
     unsigned long long gen;
     int qtype;
@@ -191,8 +231,8 @@ fastpath_put(PyObject *self, PyObject *args)
     tagbuf.buf = NULL;
     tagbuf.len = 0;
     tagbuf.obj = NULL;
-    if (!PyArg_ParseTuple(args, "Oy*iKO|ly*", &capsule, &keybuf, &qtype,
-                          &gen, &wires, &expiry_ms, &tagbuf))
+    if (!PyArg_ParseTuple(args, "Oy*iKO|ly*O", &capsule, &keybuf, &qtype,
+                          &gen, &wires, &expiry_ms, &tagbuf, &frags))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     if (c == NULL) {
@@ -214,6 +254,9 @@ fastpath_put(PyObject *self, PyObject *args)
         /* borrow the wire pointers (valid while `fast` is held) */
         const uint8_t *wire_ptrs[FP_MAX_VARIANTS];
         uint16_t wire_lens[FP_MAX_VARIANTS];
+        const uint8_t *frag_ptrs[FP_MAX_VARIANTS];
+        uint16_t frag_lens[FP_MAX_VARIANTS];
+        PyObject *frag_fast = NULL;
         int sizes_ok = 1;
         for (Py_ssize_t i = 0; i < nw; i++) {
             char *data;
@@ -233,15 +276,28 @@ fastpath_put(PyObject *self, PyObject *args)
             wire_ptrs[i] = (const uint8_t *)data;
             wire_lens[i] = (uint16_t)dlen;
         }
-        if (sizes_ok) {
+        int frc = sizes_ok
+            ? fp_load_frags(frags, nw, &frag_fast, frag_ptrs, frag_lens)
+            : 1;
+        if (frc < 0) {
+            Py_DECREF(fast);
+            PyBuffer_Release(&keybuf);
+            if (tagbuf.obj != NULL)
+                PyBuffer_Release(&tagbuf);
+            return NULL;
+        }
+        if (sizes_ok && frc > 0) {
             double expiry_s = expiry_ms >= 0 ? (double)expiry_ms / 1000.0
                                              : c->expiry_s;
             rc = fp_put_raw(c, keybuf.buf, (size_t)keybuf.len,
                             (uint16_t)qtype, (uint64_t)gen, wire_ptrs,
                             wire_lens, (int)nw, fp_now(), expiry_s,
                             (const uint8_t *)tagbuf.buf,
-                            (size_t)tagbuf.len);
+                            (size_t)tagbuf.len,
+                            frag_fast != NULL ? frag_ptrs : NULL,
+                            frag_fast != NULL ? frag_lens : NULL);
         }
+        Py_XDECREF(frag_fast);
     }
     Py_DECREF(fast);
     PyBuffer_Release(&keybuf);
@@ -259,13 +315,14 @@ fastpath_zone_put(PyObject *self, PyObject *args)
 {
     (void)self;
     PyObject *capsule, *bodies;
+    PyObject *frags = NULL;
     Py_buffer zkeybuf, tagbuf;
     unsigned long long gen;
     int ancount;
     int arcount = 0;
 
-    if (!PyArg_ParseTuple(args, "Oy*KiOy*|i", &capsule, &zkeybuf, &gen,
-                          &ancount, &bodies, &tagbuf, &arcount))
+    if (!PyArg_ParseTuple(args, "Oy*KiOy*|iO", &capsule, &zkeybuf, &gen,
+                          &ancount, &bodies, &tagbuf, &arcount, &frags))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     PyObject *fast = c != NULL
@@ -282,6 +339,9 @@ fastpath_zone_put(PyObject *self, PyObject *args)
             && nv >= 1 && nv <= FP_MAX_VARIANTS) {
         const uint8_t *body_ptrs[FP_MAX_VARIANTS];
         uint16_t body_lens[FP_MAX_VARIANTS];
+        const uint8_t *frag_ptrs[FP_MAX_VARIANTS];
+        uint16_t frag_lens[FP_MAX_VARIANTS];
+        PyObject *frag_fast = NULL;
         int sizes_ok = 1;
         for (Py_ssize_t i = 0; i < nv; i++) {
             char *data;
@@ -300,13 +360,25 @@ fastpath_zone_put(PyObject *self, PyObject *args)
             body_ptrs[i] = (const uint8_t *)data;
             body_lens[i] = (uint16_t)dlen;
         }
-        if (sizes_ok)
+        int frc = sizes_ok
+            ? fp_load_frags(frags, nv, &frag_fast, frag_ptrs, frag_lens)
+            : 1;
+        if (frc < 0) {
+            Py_DECREF(fast);
+            PyBuffer_Release(&zkeybuf);
+            PyBuffer_Release(&tagbuf);
+            return NULL;
+        }
+        if (sizes_ok && frc > 0)
             rc = fp_zone_put(c, zkeybuf.buf, (size_t)zkeybuf.len,
                              (uint64_t)gen, (uint16_t)ancount,
                              (uint16_t)arcount, body_ptrs,
                              body_lens, (int)nv,
                              (const uint8_t *)tagbuf.buf,
-                             (size_t)tagbuf.len);
+                             (size_t)tagbuf.len,
+                             frag_fast != NULL ? frag_ptrs : NULL,
+                             frag_fast != NULL ? frag_lens : NULL);
+        Py_XDECREF(frag_fast);
     }
     Py_DECREF(fast);
     PyBuffer_Release(&zkeybuf);
@@ -325,8 +397,12 @@ fastpath_serve_wire(PyObject *self, PyObject *args)
     PyObject *capsule;
     Py_buffer pkt;
     unsigned long long gen;
+    const char *client = NULL;
+    const char *proto = "tcp";
+    unsigned port = 0;
 
-    if (!PyArg_ParseTuple(args, "Oy*K", &capsule, &pkt, &gen))
+    if (!PyArg_ParseTuple(args, "Oy*K|sIs", &capsule, &pkt, &gen,
+                          &client, &port, &proto))
         return NULL;
     fp_cache_t *c = fp_from_capsule(capsule);
     if (c == NULL) {
@@ -336,13 +412,17 @@ fastpath_serve_wire(PyObject *self, PyObject *args)
     static uint8_t out[FP_MAX_WIRE];
     uint16_t qtype = 0;
     double t0 = fp_now();
+    /* logged posture: the caller must supply the client context or the
+     * serve declines inside the core (parity: Python then logs) */
+    fp_logsrc_t src = { client, port, proto };
     /* decline_tc: TC responses cached off the UDP path are correct for
      * UDP requesters but must never replay over TCP (Python answers
      * those in full — its cache keys carry transport semantics; this
      * entry point cannot know the transport, so the core declines every
      * truncated wire before any hit accounting) */
-    size_t wlen = fp_serve_one_ex(c, pkt.buf, (size_t)pkt.len,
-                                  (uint64_t)gen, t0, out, &qtype, 1);
+    size_t wlen = fp_serve_one_lx(c, pkt.buf, (size_t)pkt.len,
+                                  (uint64_t)gen, t0, out, &qtype, 1,
+                                  client != NULL ? &src : NULL);
     PyBuffer_Release(&pkt);
     if (wlen == 0)
         Py_RETURN_NONE;
@@ -444,8 +524,33 @@ fastpath_drain(PyObject *self, PyObject *args)
         uint16_t entry_qtype = 0;
         uint8_t *out = outs[n_hits];
 
-        size_t wlen = fp_serve_one(c, pkt, plen, (uint64_t)gen, t0, out,
-                                   &entry_qtype);
+        /* logged posture: stringify this packet's source so the core
+         * can emit its log line (only when the ring is armed) */
+        char client[INET6_ADDRSTRLEN];
+        fp_logsrc_t src = { NULL, 0, "udp" };
+        if (c->lr.enabled) {
+            const struct sockaddr_storage *ss = &addrs[i];
+            if (ss->ss_family == AF_INET) {
+                const struct sockaddr_in *sa =
+                    (const struct sockaddr_in *)ss;
+                if (inet_ntop(AF_INET, &sa->sin_addr, client,
+                              sizeof(client)) != NULL) {
+                    src.client = client;
+                    src.port = ntohs(sa->sin_port);
+                }
+            } else if (ss->ss_family == AF_INET6) {
+                const struct sockaddr_in6 *sa6 =
+                    (const struct sockaddr_in6 *)ss;
+                if (inet_ntop(AF_INET6, &sa6->sin6_addr, client,
+                              sizeof(client)) != NULL) {
+                    src.client = client;
+                    src.port = ntohs(sa6->sin6_port);
+                }
+            }
+        }
+        size_t wlen = fp_serve_one_lx(c, pkt, plen, (uint64_t)gen, t0,
+                                      out, &entry_qtype, 0,
+                                      src.client != NULL ? &src : NULL);
         if (wlen == 0) {
             /* miss: surface to Python exactly like recv_batch */
             if (surface_miss(misses, pkt, plen, &addrs[i]) < 0) {
@@ -514,6 +619,53 @@ fastpath_drain(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_log_enable(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    Py_buffer prefix;
+    unsigned long cap = 1u << 20;
+
+    if (!PyArg_ParseTuple(args, "Oy*|k", &capsule, &prefix, &cap))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&prefix);
+        return NULL;
+    }
+    int rc = fp_log_enable(c, (const uint8_t *)prefix.buf,
+                           (size_t)prefix.len, (size_t)cap);
+    PyBuffer_Release(&prefix);
+    if (rc < 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "log ring enable failed (prefix/capacity)");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject *
+fastpath_log_drain(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+
+    if (!PyArg_ParseTuple(args, "O", &capsule))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL)
+        return NULL;
+    if (!c->lr.enabled || c->lr.len == 0)
+        return PyBytes_FromStringAndSize(NULL, 0);
+    PyObject *out = PyBytes_FromStringAndSize((const char *)c->lr.buf,
+                                              (Py_ssize_t)c->lr.len);
+    if (out == NULL)
+        return NULL;
+    c->lr.len = 0;
+    return out;
+}
+
+PyObject *
 fastpath_stats(PyObject *self, PyObject *args)
 {
     (void)self;
@@ -563,7 +715,7 @@ fastpath_stats(PyObject *self, PyObject *args)
         }
     }
     return Py_BuildValue(
-        "{s:K,s:K,s:I,s:K,s:K,s:K,s:I,s:K,s:N}",
+        "{s:K,s:K,s:I,s:K,s:K,s:K,s:I,s:K,s:K,s:K,s:K,s:N}",
         "hits", (unsigned long long)c->hits,
         "lookups", (unsigned long long)c->lookups,
         "entries", (unsigned)c->n_entries,
@@ -572,6 +724,9 @@ fastpath_stats(PyObject *self, PyObject *args)
         "zone_hits", (unsigned long long)c->zone_hits,
         "zone_entries", (unsigned)(c->zmain.n + c->zalien.n),
         "zone_bytes", (unsigned long long)c->ztotal_bytes,
+        "log_lines", (unsigned long long)c->lr.lines,
+        "log_declines", (unsigned long long)c->lr.declines,
+        "log_pending", (unsigned long long)c->lr.len,
         "per_qtype", per);
 }
 

@@ -15,6 +15,7 @@
 #define BINDER_FPCORE_H
 
 #include <stdint.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <time.h>
@@ -31,6 +32,49 @@
 #define FP_QTYPE_OTHER 0xFFFF     /* stats catch-all past FP_MAX_QTYPES */
 
 #define FP_MAX_TAG 256            /* a qname in wire label format */
+
+/*
+ * Query-log ring: lets the fast path serve while per-query logging is
+ * on (the reference's always-on posture, lib/server.js:537-591) instead
+ * of standing down.  Entries carry a pre-rendered JSON *fragment* (the
+ * answer-dependent middle of the log line: query/cached/rcode/answers/
+ * additional — rendered ONCE at push time by Python, not per query);
+ * at serve time the C side appends one complete bunyan-style line to a
+ * byte ring: constant prefix (name/hostname/pid/level/component/msg,
+ * supplied by Python at enable time) + timestamp + per-query fields
+ * (req id, client, port/proto, edns) + the fragment + latency.  Python
+ * drains the ring in batches and writes it to the log stream — one
+ * stream write per batch, not one formatting pass per query.
+ *
+ * Parity rule: a serve that CANNOT produce its log line (no fragment
+ * pushed, ring full because Python is draining too slowly, no client
+ * address available) must DECLINE to Python — which logs normally —
+ * never serve-and-drop the line.  Logged-posture serving degrades to
+ * the slow path under pressure; it never loses log records.
+ */
+#define FP_MAX_FRAG 4096          /* per-variant pre-rendered fragment */
+#define FP_LOG_PREFIX_MAX 512    /* constant line head from Python */
+#define FP_LOG_OVERHEAD 256      /* time+id+client+port+latency+glue */
+
+typedef struct {                  /* per-serve source context */
+    const char *client;           /* numeric address string, JSON-safe */
+    unsigned port;
+    const char *proto;            /* "udp" / "tcp" / "balancer" */
+} fp_logsrc_t;
+
+typedef struct {
+    uint8_t *buf;
+    size_t cap;
+    size_t len;
+    uint64_t lines;               /* lines appended since enable */
+    uint64_t declines;            /* serves declined for log reasons */
+    uint8_t prefix[FP_LOG_PREFIX_MAX];
+    size_t prefix_len;
+    int enabled;
+    time_t cached_sec;            /* strftime result reused per second */
+    char secbuf[24];
+    int secbuf_len;
+} fp_logring_t;
 
 typedef struct {
     uint8_t key[FP_MAX_KEY];
@@ -52,6 +96,10 @@ typedef struct {
     uint16_t qtype;
     uint8_t *wires[FP_MAX_VARIANTS];
     uint16_t wire_lens[FP_MAX_VARIANTS];
+    /* pre-rendered per-variant log fragments (NULL when pushed in the
+     * log-off posture; such entries decline when logging is on) */
+    uint8_t *frags[FP_MAX_VARIANTS];
+    uint16_t frag_lens[FP_MAX_VARIANTS];
     int used;
 } fp_entry_t;
 
@@ -96,6 +144,8 @@ typedef struct {
     /* answer(+additional) sections; compression ptrs target offset 12 */
     uint8_t *bodies[FP_MAX_VARIANTS];
     uint16_t body_lens[FP_MAX_VARIANTS];
+    uint8_t *frags[FP_MAX_VARIANTS];
+    uint16_t frag_lens[FP_MAX_VARIANTS];
     int used;
 } fp_zentry_t;
 
@@ -133,6 +183,7 @@ typedef struct {
     fp_ztab_t zalien;         /* tag != qname: scan invalidation */
     uint64_t ztotal_bytes;
     uint64_t zone_hits;
+    fp_logring_t lr;
 } fp_cache_t;
 
 /* EDNS OPT echoed on zone serves: root name, type 41, payload 1232,
@@ -167,6 +218,11 @@ fp_entry_free(fp_cache_t *c, fp_entry_t *e)
         c->total_bytes -= e->wire_lens[i];
         free(e->wires[i]);
         e->wires[i] = NULL;
+        if (e->frags[i] != NULL) {
+            c->total_bytes -= e->frag_lens[i];
+            free(e->frags[i]);
+            e->frags[i] = NULL;
+        }
     }
     e->n_variants = 0;
     if (e->used) {
@@ -199,6 +255,11 @@ fp_zentry_free(fp_cache_t *c, fp_ztab_t *t, fp_zentry_t *e)
         c->ztotal_bytes -= e->body_lens[i];
         free(e->bodies[i]);
         e->bodies[i] = NULL;
+        if (e->frags[i] != NULL) {
+            c->ztotal_bytes -= e->frag_lens[i];
+            free(e->frags[i]);
+            e->frags[i] = NULL;
+        }
     }
     e->n_variants = 0;
     if (e->used) {
@@ -241,6 +302,95 @@ fp_core_free(fp_cache_t *c)
     c->zmain.slots = NULL;
     free(c->zalien.slots);
     c->zalien.slots = NULL;
+    free(c->lr.buf);
+    c->lr.buf = NULL;
+    c->lr.enabled = 0;
+}
+
+/* ---------------- query-log ring ---------------- */
+
+/* Arm the log ring: `prefix` is the constant head of every line, up to
+ * and including `"time":"` (Python renders it once from its logger
+ * identity).  Returns 0 ok, -1 on OOM/bad args. */
+static inline int
+fp_log_enable(fp_cache_t *c, const uint8_t *prefix, size_t plen,
+              size_t cap)
+{
+    if (plen == 0 || plen > FP_LOG_PREFIX_MAX)
+        return -1;
+    if (cap < 4096)
+        cap = 4096;
+    uint8_t *buf = (uint8_t *)malloc(cap);
+    if (buf == NULL)
+        return -1;
+    free(c->lr.buf);
+    memset(&c->lr, 0, sizeof(c->lr));
+    c->lr.buf = buf;
+    c->lr.cap = cap;
+    memcpy(c->lr.prefix, prefix, plen);
+    c->lr.prefix_len = plen;
+    c->lr.cached_sec = (time_t)-1;
+    c->lr.enabled = 1;
+    return 0;
+}
+
+static inline void
+fp_log_disable(fp_cache_t *c)
+{
+    free(c->lr.buf);
+    memset(&c->lr, 0, sizeof(c->lr));
+}
+
+/* room for one line with an `fraglen`-byte fragment?  (the decline
+ * check run BEFORE a serve commits to answering natively) */
+static inline int
+fp_log_room(const fp_cache_t *c, size_t fraglen)
+{
+    return c->lr.len + c->lr.prefix_len + fraglen + FP_LOG_OVERHEAD
+        <= c->lr.cap;
+}
+
+/* append the RFC3339 UTC timestamp; seconds part cached per second */
+static inline int
+fp_log_time(fp_logring_t *lr, char *p)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    if (ts.tv_sec != lr->cached_sec) {
+        struct tm tm;
+        gmtime_r(&ts.tv_sec, &tm);
+        lr->secbuf_len = (int)strftime(lr->secbuf, sizeof(lr->secbuf),
+                                       "%Y-%m-%dT%H:%M:%S", &tm);
+        lr->cached_sec = ts.tv_sec;
+    }
+    memcpy(p, lr->secbuf, (size_t)lr->secbuf_len);
+    return lr->secbuf_len + sprintf(p + lr->secbuf_len, ".%03ldZ",
+                                    ts.tv_nsec / 1000000L);
+}
+
+/* Append one complete log line.  The caller has already verified
+ * fp_log_room for this fragment; src/frag are non-NULL. */
+static inline void
+fp_log_append(fp_cache_t *c, const uint8_t *pkt, int edns,
+              const uint8_t *frag, size_t fraglen,
+              const fp_logsrc_t *src, double lat_ms)
+{
+    fp_logring_t *lr = &c->lr;
+    char *base = (char *)lr->buf;
+    char *p = base + lr->len;
+    memcpy(p, lr->prefix, lr->prefix_len);
+    p += lr->prefix_len;
+    p += fp_log_time(lr, p);
+    p += sprintf(p,
+                 "\",\"v\":0,\"req_id\":%u,\"client\":\"%s\","
+                 "\"port\":\"%u/%s\",\"edns\":%s,",
+                 (unsigned)((pkt[0] << 8) | pkt[1]), src->client,
+                 src->port, src->proto, edns ? "true" : "false");
+    memcpy(p, frag, fraglen);
+    p += fraglen;
+    p += sprintf(p, ",\"latency\":%.3f,\"timers\":{}}\n", lat_ms);
+    lr->len = (size_t)(p - base);
+    lr->lines++;
 }
 
 static inline int
@@ -302,14 +452,18 @@ fp_find(fp_cache_t *c, const uint8_t *key, size_t keylen, uint64_t gen,
 /*
  * Insert or replace an entry.  `expiry_s` is the effective lifetime for
  * THIS entry (the pusher may hand down a remaining lifetime shorter than
- * the cache-wide default).  Returns 1 stored, 0 skipped (bounds/caps),
- * -1 OOM (entry freed, cache consistent).
+ * the cache-wide default).  `frags`/`frag_lens` (may be NULL) are the
+ * per-variant pre-rendered log fragments for the logged posture; an
+ * entry without them declines to Python whenever the log ring is on.
+ * Returns 1 stored, 0 skipped (bounds/caps), -1 OOM (entry freed,
+ * cache consistent).
  */
 static inline int
 fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
            uint16_t qtype, uint64_t gen, const uint8_t *const *wires,
            const uint16_t *wire_lens, int nw, double now, double expiry_s,
-           const uint8_t *tag, size_t taglen)
+           const uint8_t *tag, size_t taglen,
+           const uint8_t *const *frags, const uint16_t *frag_lens)
 {
     if (keylen < 8 || keylen > FP_MAX_KEY)
         return 0;                       /* not representable: skip */
@@ -321,6 +475,12 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
     for (int i = 0; i < nw; i++) {
         if (wire_lens[i] < 12 || wire_lens[i] > FP_MAX_WIRE)
             return 0;                   /* oversize answers stay in Python */
+        if (frags != NULL) {
+            if (frags[i] == NULL || frag_lens[i] == 0
+                    || frag_lens[i] > FP_MAX_FRAG)
+                return 0;               /* unloggable: stays in Python */
+            add_bytes += (uint64_t)frag_lens[i];
+        }
         add_bytes += (uint64_t)wire_lens[i];
     }
     if (c->total_bytes + add_bytes > FP_MAX_TOTAL_BYTES)
@@ -367,8 +527,22 @@ fp_put_raw(fp_cache_t *c, const uint8_t *key, size_t keylen,
         memcpy(copy, wires[i], (size_t)wire_lens[i]);
         target->wires[i] = copy;
         target->wire_lens[i] = wire_lens[i];
-        target->n_variants = (uint8_t)(i + 1);
+        target->frags[i] = NULL;
+        target->frag_lens[i] = 0;
         c->total_bytes += (uint64_t)wire_lens[i];
+        if (frags != NULL) {
+            uint8_t *fc = (uint8_t *)malloc((size_t)frag_lens[i]);
+            if (fc == NULL) {
+                target->n_variants = (uint8_t)(i + 1);
+                fp_entry_free(c, target);
+                return -1;
+            }
+            memcpy(fc, frags[i], (size_t)frag_lens[i]);
+            target->frags[i] = fc;
+            target->frag_lens[i] = frag_lens[i];
+            c->total_bytes += (uint64_t)frag_lens[i];
+        }
+        target->n_variants = (uint8_t)(i + 1);
     }
     target->used = 1;
     c->n_entries++;
@@ -467,7 +641,8 @@ static inline int
 fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
             uint64_t gen, uint16_t ancount, uint16_t arcount,
             const uint8_t *const *bodies, const uint16_t *body_lens,
-            int nv, const uint8_t *tag, size_t taglen)
+            int nv, const uint8_t *tag, size_t taglen,
+            const uint8_t *const *frags, const uint16_t *frag_lens)
 {
     if (zklen < 5 || zklen > FP_MAX_KEY)
         return 0;
@@ -479,6 +654,12 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
     for (int i = 0; i < nv; i++) {
         if (body_lens[i] == 0 || body_lens[i] > FP_MAX_WIRE)
             return 0;
+        if (frags != NULL) {
+            if (frags[i] == NULL || frag_lens[i] == 0
+                    || frag_lens[i] > FP_MAX_FRAG)
+                return 0;           /* unloggable: stays in Python */
+            add += frag_lens[i];
+        }
         add += body_lens[i];
     }
     if (c->ztotal_bytes + add > FP_ZONE_MAX_BYTES)
@@ -543,8 +724,22 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
         memcpy(copy, bodies[i], (size_t)body_lens[i]);
         target->bodies[i] = copy;
         target->body_lens[i] = body_lens[i];
-        target->n_variants = (uint8_t)(i + 1);
+        target->frags[i] = NULL;
+        target->frag_lens[i] = 0;
         c->ztotal_bytes += (uint64_t)body_lens[i];
+        if (frags != NULL) {
+            uint8_t *fc = (uint8_t *)malloc((size_t)frag_lens[i]);
+            if (fc == NULL) {
+                target->n_variants = (uint8_t)(i + 1);
+                fp_zentry_free(c, t, target);
+                return -1;
+            }
+            memcpy(fc, frags[i], (size_t)frag_lens[i]);
+            target->frags[i] = fc;
+            target->frag_lens[i] = frag_lens[i];
+            c->ztotal_bytes += (uint64_t)frag_lens[i];
+        }
+        target->n_variants = (uint8_t)(i + 1);
     }
     target->used = 1;
     t->n++;
@@ -620,7 +815,7 @@ fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
 static inline size_t
 fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
               size_t keylen, size_t qn_len, uint64_t gen, uint8_t *out,
-              uint16_t *qtype_out)
+              uint16_t *qtype_out, double now, const fp_logsrc_t *src)
 {
     /* table routing mirrors fp_zone_put exactly: (A|PTR, IN) keys can
      * only live in zmain, everything else only in zalien — probing the
@@ -641,6 +836,15 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
     unsigned payload = ((unsigned)key[1] << 8) | key[2];
 
     uint8_t v = e->next_variant;
+    if (c->lr.enabled) {
+        /* logged posture: a serve whose log line cannot be produced
+         * declines (BEFORE rotation/accounting) — Python logs it */
+        if (src == NULL || e->frags[v] == NULL
+                || !fp_log_room(c, e->frag_lens[v])) {
+            c->lr.declines++;
+            return 0;
+        }
+    }
     e->next_variant = (uint8_t)((v + 1) % e->n_variants);
     size_t blen = e->body_lens[v];
     size_t total = 12 + qn_len + 4 + blen + (edns ? sizeof(fp_opt_echo) : 0);
@@ -670,6 +874,9 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
     if (qtype_out != NULL)
         *qtype_out = e->qtype;
     c->zone_hits++;
+    if (c->lr.enabled)
+        fp_log_append(c, pkt, edns, e->frags[v], e->frag_lens[v], src,
+                      (fp_now() - now) * 1e3);
     return total;
 }
 
@@ -686,9 +893,10 @@ fp_zone_serve(fp_cache_t *c, const uint8_t *pkt, const uint8_t *key,
  * rotation step.  The UDP drain passes 0 (TC wires are correct there).
  */
 static inline size_t
-fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
+fp_serve_one_lx(fp_cache_t *c, const uint8_t *pkt, size_t plen,
                 uint64_t gen, double now, uint8_t *out,
-                uint16_t *qtype_out, int decline_tc)
+                uint16_t *qtype_out, int decline_tc,
+                const fp_logsrc_t *src)
 {
     uint8_t key[FP_MAX_KEY];
     size_t qn_len = 0;
@@ -704,7 +912,7 @@ fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
          * serves it natively (first query for a name included; zone
          * entries are never truncated, so decline_tc is moot there) */
         return fp_zone_serve(c, pkt, key, keylen, qn_len, gen, out,
-                             qtype_out);
+                             qtype_out, now, src);
 
     /* hit: copy the variant, patch id + the client's question bytes
      * (same length by construction — key match implies identical
@@ -712,6 +920,15 @@ fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
     uint8_t v = e->next_variant;
     if (decline_tc && e->wire_lens[v] >= 3 && (e->wires[v][2] & 0x02))
         return 0;
+    if (c->lr.enabled) {
+        /* logged posture: decline (before rotation/accounting) when the
+         * line can't be produced — Python serves AND logs instead */
+        if (src == NULL || e->frags[v] == NULL
+                || !fp_log_room(c, e->frag_lens[v])) {
+            c->lr.declines++;
+            return 0;
+        }
+    }
     e->next_variant = (uint8_t)((v + 1) % e->n_variants);
     const uint8_t *wire = e->wires[v];
     size_t wlen = e->wire_lens[v];
@@ -727,7 +944,19 @@ fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
     if (qtype_out != NULL)
         *qtype_out = e->qtype;
     c->hits++;
+    if (c->lr.enabled)
+        fp_log_append(c, pkt, key[0] & 2, e->frags[v], e->frag_lens[v],
+                      src, (fp_now() - now) * 1e3);
     return wlen;
+}
+
+static inline size_t
+fp_serve_one_ex(fp_cache_t *c, const uint8_t *pkt, size_t plen,
+                uint64_t gen, double now, uint8_t *out,
+                uint16_t *qtype_out, int decline_tc)
+{
+    return fp_serve_one_lx(c, pkt, plen, gen, now, out, qtype_out,
+                           decline_tc, NULL);
 }
 
 /* drain-path spelling: TC wires serve (UDP requesters asked for them) */

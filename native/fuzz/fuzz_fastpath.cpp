@@ -82,6 +82,22 @@ void fuzz_one(const uint8_t *data, size_t len) {
     if (fz_iter % 53 == 0)
         fz_clock += 120.0;              /* TTL expiry (cache-wide 60s) */
 
+    /* alternate the query-log ring on and off (small capacity so the
+     * backpressure decline path fires); periodically "drain" it the
+     * way the Python side does */
+    if (fz_iter % 29 == 0) {
+        if (fz_c->lr.enabled) {
+            fp_log_disable(fz_c);
+        } else {
+            static const uint8_t pfx[] =
+                "{\"name\":\"binder\",\"msg\":\"DNS query\",\"time\":\"";
+            int lrc = fp_log_enable(fz_c, pfx, sizeof(pfx) - 1, 4096);
+            assert(lrc == 0);
+        }
+    }
+    if (fz_c->lr.enabled && fz_iter % 13 == 0)
+        fz_c->lr.len = 0;               /* drained by Python */
+
     uint8_t out[FP_MAX_WIRE];
 
     if (fz_iter % 3 == 0) {
@@ -126,19 +142,43 @@ void fuzz_one(const uint8_t *data, size_t len) {
         int alien = (len > 3 && data[3] % 7 == 0);
         uint16_t arcount = (uint16_t)(len > 4 && data[4] % 3 == 0
                                       ? 1 + data[4] % 2 : 0);
+        /* in ring-on iterations, push per-variant log fragments and
+         * serve with a source context — exercising fp_log_append's
+         * formatting and the room-decline backpressure path */
+        static const uint8_t zfrag[] = "\"rcode\":\"NOERROR\",\"z\":1";
+        const uint8_t *zfrags[FP_MAX_VARIANTS];
+        uint16_t zflens[FP_MAX_VARIANTS];
+        for (int i = 0; i < nv; i++) {
+            zfrags[i] = zfrag;
+            zflens[i] = (uint16_t)(sizeof(zfrag) - 1);
+        }
+        int ring = fz_c->lr.enabled;
         int rc = fp_zone_put(fz_c, key + 3, klen - 3, fz_gen, ancount,
                              arcount, bodies, blens, nv,
                              alien ? fz_alien_tag : tag,
-                             alien ? sizeof(fz_alien_tag) : taglen);
+                             alien ? sizeof(fz_alien_tag) : taglen,
+                             ring ? zfrags : nullptr,
+                             ring ? zflens : nullptr);
         assert(rc >= 0);
 
         if (rc == 1) {
             uint16_t got_qtype = 0;
-            size_t wlen = fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock,
-                                       out, &got_qtype);
+            fp_logsrc_t zsrc = { "192.0.2.7", 5353, "udp" };
+            uint64_t lines_before = fz_c->lr.lines;
+            int had_room = !ring
+                || fp_log_room(fz_c, sizeof(zfrag) - 1);
+            size_t wlen = fp_serve_one_lx(fz_c, q, qlen, fz_gen,
+                                          fz_clock, out, &got_qtype, 0,
+                                          ring ? &zsrc : nullptr);
+            if (ring && wlen > 0)
+                assert(fz_c->lr.lines == lines_before + 1);
             size_t want = 12 + qn_len + 4 + blens[0];
             if (want > DNSKEY_CLASSIC_PAYLOAD) {
                 /* would truncate: must decline to the slow path */
+                assert(wlen == 0);
+            } else if (!had_room) {
+                /* ring backpressure: must decline, never serve-and-
+                 * drop the log line */
                 assert(wlen == 0);
             } else {
                 assert(wlen == want);
@@ -207,8 +247,19 @@ void fuzz_one(const uint8_t *data, size_t len) {
          * for host answers); qname starts at key offset 7 */
         const uint8_t *tag = key + 7;
         size_t taglen = klen - 7;
+        static const uint8_t cfrag[] =
+            "\"cached\":true,\"rcode\":\"NOERROR\"";
+        const uint8_t *cfrags[FP_MAX_VARIANTS];
+        uint16_t cflens[FP_MAX_VARIANTS];
+        for (int i = 0; i < nw; i++) {
+            cfrags[i] = cfrag;
+            cflens[i] = (uint16_t)(sizeof(cfrag) - 1);
+        }
+        int ring = fz_c->lr.enabled;
         int rc = fp_put_raw(fz_c, key, klen, qtype, fz_gen, wires, lens,
-                            nw, fz_clock, fz_c->expiry_s, tag, taglen);
+                            nw, fz_clock, fz_c->expiry_s, tag, taglen,
+                            ring ? cfrags : nullptr,
+                            ring ? cflens : nullptr);
         assert(rc >= 0);                /* OOM is the only -1 */
 
         if (rc == 1 && fz_iter % 31 == 0) {
@@ -231,18 +282,29 @@ void fuzz_one(const uint8_t *data, size_t len) {
             /* round-trip: serving the same query must hit variant 0 and
              * patch the id + question bytes back in */
             uint16_t got_qtype = 0;
-            size_t wlen = fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock,
-                                       out, &got_qtype);
-            assert(wlen > 0);
-            assert(wlen == lens[0]);
-            assert(out[0] == q[0] && out[1] == q[1]);
-            assert(memcmp(out + 12, q + 12, qn_len + 4) == 0);
-            assert(got_qtype == qtype);
+            fp_logsrc_t csrc = { "2001:db8::1", 65535, "udp" };
+            int had_room = !ring
+                || fp_log_room(fz_c, sizeof(cfrag) - 1);
+            size_t wlen = fp_serve_one_lx(fz_c, q, qlen, fz_gen,
+                                          fz_clock, out, &got_qtype, 0,
+                                          ring ? &csrc : nullptr);
+            if (ring && !had_room) {
+                assert(wlen == 0);      /* backpressure decline */
+            } else {
+                assert(wlen > 0);
+                assert(wlen == lens[0]);
+                assert(out[0] == q[0] && out[1] == q[1]);
+                assert(memcmp(out + 12, q + 12, qn_len + 4) == 0);
+                assert(got_qtype == qtype);
+            }
             /* second serve rotates to variant 1 (or back to 0) — a
-             * short variant must be dropped defensively, never served */
+             * short variant must be dropped defensively, never served.
+             * (ring-on with a NULL source must decline outright) */
             size_t w2 = fp_serve_one(fz_c, q, qlen, fz_gen, fz_clock,
                                      out, nullptr);
-            if (w2 != 0)
+            if (ring)
+                assert(w2 == 0);
+            else if (w2 != 0)
                 assert(w2 >= 12 + qn_len + 4);
         }
     }
@@ -262,8 +324,11 @@ void fuzz_one(const uint8_t *data, size_t len) {
             }
             used++;
             assert(e->n_variants >= 1);
-            for (int j = 0; j < e->n_variants; j++)
+            for (int j = 0; j < e->n_variants; j++) {
                 bytes += e->wire_lens[j];
+                if (e->frags[j] != nullptr)
+                    bytes += e->frag_lens[j];
+            }
         }
         assert(bytes == fz_c->total_bytes);
         assert(used == fz_c->n_entries);
@@ -284,8 +349,11 @@ void fuzz_one(const uint8_t *data, size_t len) {
                 }
                 zused++;
                 assert(e->n_variants >= 1);
-                for (int j = 0; j < e->n_variants; j++)
+                for (int j = 0; j < e->n_variants; j++) {
                     zbytes += e->body_lens[j];
+                    if (e->frags[j] != nullptr)
+                        zbytes += e->frag_lens[j];
+                }
                 /* every live entry must stay findable within the probe
                  * window — one displaced past it (e.g. by a rehash)
                  * would evade per-name invalidation and could serve

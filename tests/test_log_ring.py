@@ -1,0 +1,275 @@
+"""Native query-log ring: the fast path serving under per-query logging.
+
+The reference logs every query unconditionally (lib/server.js:537-591);
+before round 5 that posture forced the rebuild's native tier to stand
+down entirely.  These tests pin the round-5 contract:
+
+- with a JSON logger attached and queryLog on, the native path serves
+  (zone + answer-cache) AND every serve produces a complete bunyan-style
+  log line on the same stream the Python logger writes to;
+- the line shape matches the Python path's for the same event class
+  (cached hits log ``cached: true`` + rcode + summaries; zone serves log
+  the resolve-shape ``query`` object);
+- lanes without a C drain (TCP) log through the same ring;
+- without a JSON stream logger the old stand-down gating is unchanged.
+"""
+import asyncio
+import io
+import json
+import logging
+
+import pytest
+
+from binder_tpu.dns import Rcode, Type
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.utils.jsonlog import make_logger
+
+try:
+    from binder_tpu import _binderfastio as fastio
+except ImportError:
+    fastio = None
+
+pytestmark = pytest.mark.skipif(
+    fastio is None or not hasattr(fastio, "fastpath_log_enable"),
+    reason="native extension with log ring not built")
+
+DOMAIN = "foo.com"
+
+
+def fixture_store():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "192.168.0.1"}})
+    store.put_json("/com/foo/svc", {
+        "type": "service",
+        "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432},
+    })
+    for i in range(3):
+        store.put_json(f"/com/foo/svc/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+    store.start_session()
+    return store, cache
+
+
+async def start_logged_server(cache, stream, **kw):
+    log = make_logger("binder-logring-test", stream=stream)
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="coal", host="127.0.0.1",
+                          port=0, collector=MetricsCollector(),
+                          log=log, query_log=True, **kw)
+    await server.start()
+    return server
+
+
+from tests.test_server import tcp_ask  # shared DNS-ask helpers
+from tests.test_server import udp_ask as _server_udp_ask
+
+
+async def udp_ask(port, name, qtype, qid=4242, payload=1232):
+    return await _server_udp_ask(port, name, qtype, payload=payload,
+                                 qid=qid)
+
+
+def log_lines(server, stream):
+    server._drain_native_log()
+    return [json.loads(ln) for ln in stream.getvalue().splitlines()]
+
+
+class TestLogRing:
+    def test_ring_armed_with_json_logger(self):
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            server = await start_logged_server(cache, stream)
+            try:
+                assert server._log_ring
+                assert server._fastpath_active()
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_zone_serve_logs_resolve_shape(self):
+        """Cold A query in the logged posture: served natively from the
+        precompiled zone AND logged with the resolve-shape line."""
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            server = await start_logged_server(cache, stream)
+            try:
+                r1 = await udp_ask(server.udp_port, "web.foo.com",
+                                   Type.A, qid=100)
+                r2 = await udp_ask(server.udp_port, "web.foo.com",
+                                   Type.A, qid=101)
+                assert r1.rcode == r2.rcode == Rcode.NOERROR
+                assert r1.answers[0].address == "192.168.0.1"
+                stats = fastio.fastpath_stats(server._fastpath)
+                assert stats["zone_hits"] >= 2      # served natively
+                assert stats["log_lines"] >= 2      # ...and logged
+                lines = log_lines(server, stream)
+                qlines = [l for l in lines if l.get("msg") == "DNS query"]
+                assert len(qlines) == 2
+                for ln, qid in zip(qlines, (100, 101)):
+                    assert ln["req_id"] == qid
+                    assert ln["client"] == "127.0.0.1"
+                    assert ln["port"].endswith("/udp")
+                    assert ln["edns"] is True
+                    assert ln["rcode"] == "NOERROR"
+                    assert ln["query"] == {"srv": None,
+                                           "name": "web.foo.com",
+                                           "type": "A"}
+                    assert ln["answers"] == ["web... A 192.168.0.1"]
+                    assert ln["additional"] == []
+                    assert ln["level"] == 30
+                    assert ln["name"] == "binder-logring-test"
+                    assert isinstance(ln["latency"], float)
+                    assert "T" in ln["time"] and ln["time"].endswith("Z")
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_cached_hit_logs_cached_shape(self):
+        """A shape the zone can't serve (out-of-suffix REFUSED): first
+        query logs through Python, repeats serve natively from the
+        answer cache and log the Python hit-path shape (cached: true)."""
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            server = await start_logged_server(cache, stream)
+            try:
+                r1 = await udp_ask(server.udp_port, "x.example.com",
+                                   Type.A, qid=200)
+                r2 = await udp_ask(server.udp_port, "x.example.com",
+                                   Type.A, qid=201)
+                assert r1.rcode == r2.rcode == Rcode.REFUSED
+                stats = fastio.fastpath_stats(server._fastpath)
+                assert stats["hits"] >= 1           # native cache hit
+                lines = log_lines(server, stream)
+                by_id = {l["req_id"]: l for l in lines
+                         if l.get("msg") == "DNS query"}
+                # first: Python resolve line (has the reason field)
+                assert by_id[200]["rcode"] == "REFUSED"
+                assert by_id[200]["reason"] == \
+                    "not within dns domain suffix"
+                # repeat: native line with the hit-path shape
+                assert by_id[201]["rcode"] == "REFUSED"
+                assert by_id[201]["cached"] is True
+                assert by_id[201]["answers"] == []
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_tcp_serve_logs_through_ring(self):
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            server = await start_logged_server(cache, stream)
+            try:
+                r = await tcp_ask(server.tcp_port, "web.foo.com", Type.A,
+                                  qid=300, edns_payload=None)
+                assert r.rcode == Rcode.NOERROR
+                stats = fastio.fastpath_stats(server._fastpath)
+                assert stats["zone_hits"] >= 1
+                lines = log_lines(server, stream)
+                tcp_lines = [l for l in lines
+                             if l.get("req_id") == 300]
+                assert len(tcp_lines) == 1
+                assert tcp_lines[0]["port"].endswith("/tcp")
+                assert tcp_lines[0]["edns"] is False
+                assert tcp_lines[0]["answers"] == ["web... A 192.168.0.1"]
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_srv_zone_serve_logs_rotating_answers(self):
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            server = await start_logged_server(cache, stream)
+            try:
+                r = await udp_ask(server.udp_port,
+                                  "_pg._tcp.svc.foo.com", Type.SRV,
+                                  qid=400)
+                assert r.rcode == Rcode.NOERROR
+                assert len(r.answers) == 3
+                lines = log_lines(server, stream)
+                srv = [l for l in lines if l.get("req_id") == 400]
+                assert len(srv) == 1
+                assert srv[0]["query"]["srv"] == "_pg._tcp"
+                assert srv[0]["query"]["type"] == "SRV"
+                # logged answers must be the exact served rotation
+                served = [f"SRV {a.target.split('.')[0]}.svc...:{a.port}"
+                          for a in r.answers]
+                assert srv[0]["answers"] == served
+                assert len(srv[0]["additional"]) == 3
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_no_json_logger_keeps_stand_down(self):
+        """queryLog on with a non-JSON logger: ring unavailable, the
+        fast path stands down exactly as before round 5."""
+        async def run():
+            store, cache = fixture_store()
+            plain = logging.getLogger("binder-logring-plain")
+            plain.setLevel(logging.INFO)
+            plain.propagate = False
+            plain.handlers = [logging.NullHandler()]
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="coal",
+                                  host="127.0.0.1", port=0,
+                                  collector=MetricsCollector(),
+                                  log=plain, query_log=True)
+            await server.start()
+            try:
+                assert not server._log_ring
+                assert not server._fastpath_active()
+                r1 = await udp_ask(server.udp_port, "web.foo.com", Type.A)
+                r2 = await udp_ask(server.udp_port, "web.foo.com", Type.A)
+                assert r1.rcode == r2.rcode == Rcode.NOERROR
+                stats = fastio.fastpath_stats(server._fastpath)
+                assert stats["zone_hits"] == 0
+                assert stats["hits"] == 0
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_logged_matches_unlogged_wire(self):
+        """Differential: the logged posture must serve byte-identical
+        answers to the log-off posture (modulo id) for the same store."""
+        async def run():
+            store, cache = fixture_store()
+            stream = io.StringIO()
+            logged = await start_logged_server(cache, stream)
+            store2, cache2 = fixture_store()
+            quiet = BinderServer(zk_cache=cache2, dns_domain=DOMAIN,
+                                 datacenter_name="coal",
+                                 host="127.0.0.1", port=0,
+                                 collector=MetricsCollector(),
+                                 query_log=False)
+            await quiet.start()
+            try:
+                for name, qt in (("web.foo.com", Type.A),
+                                 ("svc.foo.com", Type.A),
+                                 ("_pg._tcp.svc.foo.com", Type.SRV),
+                                 ("1.0.168.192.in-addr.arpa", Type.PTR),
+                                 ("nope.foo.com", Type.A)):
+                    a = await udp_ask(logged.udp_port, name, qt, qid=1)
+                    b = await udp_ask(quiet.udp_port, name, qt, qid=1)
+                    assert a.rcode == b.rcode, name
+                    assert ([type(x).__name__ for x in a.answers]
+                            == [type(x).__name__ for x in b.answers]), name
+            finally:
+                await logged.stop()
+                await quiet.stop()
+
+        asyncio.run(run())

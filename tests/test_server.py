@@ -49,14 +49,15 @@ async def start_server(cache, **kw):
     return server
 
 
-async def udp_ask(port, name, qtype, payload=1232, timeout=2.0):
+async def udp_ask(port, name, qtype, payload=1232, timeout=2.0,
+                  qid=4242):
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
     class Proto(asyncio.DatagramProtocol):
         def connection_made(self, transport):
             self.transport = transport
-            q = make_query(name, qtype, qid=4242, edns_payload=payload)
+            q = make_query(name, qtype, qid=qid, edns_payload=payload)
             transport.sendto(q.encode())
 
         def datagram_received(self, data, addr):
@@ -72,9 +73,10 @@ async def udp_ask(port, name, qtype, payload=1232, timeout=2.0):
     return Message.decode(data)
 
 
-async def tcp_ask(port, name, qtype):
+async def tcp_ask(port, name, qtype, qid=7, edns_payload=1232):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    wire = make_query(name, qtype, qid=7).encode()
+    wire = make_query(name, qtype, qid=qid,
+                      edns_payload=edns_payload).encode()
     writer.write(struct.pack(">H", len(wire)) + wire)
     await writer.drain()
     (length,) = struct.unpack(">H", await reader.readexactly(2))

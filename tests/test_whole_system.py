@@ -16,6 +16,7 @@ window (session resumes on the survivor), and a backend dying with the
 balancer failing over — queries answering correctly throughout.
 """
 import asyncio
+import io
 import json
 import os
 
@@ -28,6 +29,7 @@ from binder_tpu.server import BinderServer
 from binder_tpu.store import FakeStore, MirrorCache
 from binder_tpu.store.zk_client import ZKClient
 from binder_tpu.store.zk_testserver import ZKEnsembleState, ZKTestServer
+from binder_tpu.utils.jsonlog import make_logger
 
 from tests.test_balancer import (
     BALANCER,
@@ -50,14 +52,19 @@ async def udp_ask(port, name, qtype, qid):
     return await _udp_ask(port, name, qtype, qid=qid, rd=True)
 
 
-# both serving postures: query_log=True keeps every query in Python
-# (generic path); False engages the full native stack — raw lane,
-# fastpath cache, zone precompilation, serve_wire on the balancer lane —
-# so the SAME fault scenario (ZK member death, backend death, churn)
-# also exercises native-path coherence end to end
-@pytest.mark.parametrize("query_log", [True, False],
-                         ids=["python-path", "native-path"])
-def test_everything_at_once(tmp_path, query_log):
+# all three serving postures: python-path (query_log=True, plain
+# logger) keeps every query in Python; native-path (query_log=False)
+# engages the full native stack — raw lane, fastpath cache, zone
+# precompilation, serve_wire on the balancer lane; native-logged
+# (query_log=True + JSON logger) engages the native stack WITH the
+# query-log ring — the reference-parity posture — and the test asserts
+# real log records exist for natively served queries.  The SAME fault
+# scenario (ZK member death, backend death, churn) runs in each.
+@pytest.mark.parametrize("query_log,json_log",
+                         [(True, False), (False, False), (True, True)],
+                         ids=["python-path", "native-path",
+                              "native-logged"])
+def test_everything_at_once(tmp_path, query_log, json_log):
     sockdir = str(tmp_path)
 
     async def run():
@@ -87,10 +94,22 @@ def test_everything_at_once(tmp_path, query_log):
                         {"type": "host",
                          "host": {"address": "10.99.0.7"}})
         rstore.start_session()
+        log_streams = []
+
+        def posture_log(tag):
+            # native-logged posture: a real JSON stream logger (the
+            # shape the log ring requires to arm)
+            if not json_log:
+                return None
+            stream = io.StringIO()
+            log_streams.append(stream)
+            return make_logger(f"capstone-{tag}", stream=stream)
+
         remote = BinderServer(zk_cache=rcache, dns_domain=DOMAIN,
                               datacenter_name="east", host="127.0.0.1",
                               port=0, collector=MetricsCollector(),
-                              query_log=query_log)
+                              query_log=query_log,
+                              log=posture_log("remote"))
         await remote.start()
 
         # -- 2 ZK-backed backends with recursion, behind the balancer --
@@ -113,7 +132,8 @@ def test_everything_at_once(tmp_path, query_log):
                 datacenter_name="local", recursion=recursion,
                 host="127.0.0.1", port=0,
                 balancer_socket=os.path.join(sockdir, str(i)),
-                collector=MetricsCollector(), query_log=query_log)
+                collector=MetricsCollector(), query_log=query_log,
+                log=posture_log(f"backend{i}"))
             await server.start()
             backends.append((client, cache, recursion, server))
         assert await wait_for(lambda: all(
@@ -191,6 +211,32 @@ def test_everything_at_once(tmp_path, query_log):
                 assert m.answers[0].address == "10.1.0.99"
             m = await udp_ask(port, "db.east.foo.com", Type.A, 50)
             assert m.answers[0].address == "10.99.0.7"
+
+            if json_log:
+                # reference-parity posture: the native stack must have
+                # served under logging AND produced real log records
+                native_lines = 0
+                for _cl, _c, _r, s in backends[1:] + backends[:1]:
+                    if s._fastpath is None:
+                        continue
+                    assert s._log_ring, "log ring failed to arm"
+                    s._drain_native_log()
+                    import binder_tpu.server as _srv
+                    stats = _srv._fastio.fastpath_stats(s._fastpath)
+                    native_lines += stats["log_lines"]
+                assert native_lines > 0, \
+                    "no natively-logged serves in the logged posture"
+                records = []
+                for stream in log_streams:
+                    for ln in stream.getvalue().splitlines():
+                        rec = json.loads(ln)
+                        if rec.get("msg") == "DNS query":
+                            records.append(rec)
+                # every answered query above must have left a record —
+                # at minimum the early web.foo.com serves
+                assert any(r.get("query", {}).get("name") ==
+                           "web.foo.com" for r in records)
+                assert len(records) >= native_lines
         finally:
             proc.kill()
             await proc.wait()
