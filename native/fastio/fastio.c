@@ -291,6 +291,8 @@ static PyMethodDef fastio_methods[] = {
      "fastpath_clear(cache) -> None"},
     {"fastpath_invalidate", fastpath_invalidate, METH_VARARGS,
      "fastpath_invalidate(cache, tag_qname_wire) -> dropped count"},
+    {"fastpath_invalidate_many", fastpath_invalidate_many, METH_VARARGS,
+     "fastpath_invalidate_many(cache, [tag_qname_wire, ...]) -> dropped"},
     {"fastpath_log_enable", fastpath_log_enable, METH_VARARGS,
      "fastpath_log_enable(cache, line_prefix, capacity=1MiB) -> None"},
     {"fastpath_log_drain", fastpath_log_drain, METH_VARARGS,

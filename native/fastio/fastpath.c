@@ -619,6 +619,43 @@ fastpath_drain(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_invalidate_many(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule, *tags;
+
+    if (!PyArg_ParseTuple(args, "OO", &capsule, &tags))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    PyObject *fast = c != NULL
+        ? PySequence_Fast(tags, "tags must be a sequence") : NULL;
+    if (fast == NULL)
+        return NULL;
+    Py_ssize_t total = PySequence_Fast_GET_SIZE(fast);
+    unsigned long dropped = 0;
+    for (Py_ssize_t off = 0; off < total; off += FP_INVAL_BATCH) {
+        const uint8_t *tag_ptrs[FP_INVAL_BATCH];
+        size_t tag_lens[FP_INVAL_BATCH];
+        int n = 0;
+        for (; n < FP_INVAL_BATCH && off + n < total; n++) {
+            char *data;
+            Py_ssize_t dlen;
+            if (PyBytes_AsStringAndSize(
+                    PySequence_Fast_GET_ITEM(fast, off + n),
+                    &data, &dlen) < 0) {
+                Py_DECREF(fast);
+                return NULL;
+            }
+            tag_ptrs[n] = (const uint8_t *)data;
+            tag_lens[n] = (size_t)dlen;
+        }
+        dropped += fp_invalidate_tags(c, tag_ptrs, tag_lens, n);
+    }
+    Py_DECREF(fast);
+    return PyLong_FromUnsignedLong(dropped);
+}
+
+PyObject *
 fastpath_log_enable(PyObject *self, PyObject *args)
 {
     (void)self;

@@ -758,35 +758,77 @@ fp_zone_put(fp_cache_t *c, const uint8_t *zkey, size_t zklen,
  * tens of thousands of invalidation events arrive while the zone table
  * is large.  Returns the number of entries dropped.
  */
+#define FP_INVAL_BATCH 32   /* tags per batched invalidation pass */
+
+/* Batched spelling: ONE pass over each scanned table for up to
+ * FP_INVAL_BATCH tags.  A single store mutation emits several tags
+ * (name, parent service, old/new PTR qnames); per-tag scans would cost
+ * one full cache-table walk each, and mutation storms multiply that —
+ * the batch form keeps the churn path at one walk per event. */
 static inline uint32_t
-fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
+fp_invalidate_tags(fp_cache_t *c, const uint8_t *const *tags,
+                   const size_t *taglens, int ntags)
 {
-    if (taglen == 0 || taglen > FP_MAX_TAG)
+    if (ntags > FP_INVAL_BATCH) {
+        /* oversize batches recurse in chunks — truncating instead
+         * would silently leave tags 33+ serving pre-mutation answers,
+         * the exact coherence violation this path exists to prevent */
+        uint32_t n = 0;
+        for (int off = 0; off < ntags; off += FP_INVAL_BATCH) {
+            int chunk = ntags - off;
+            if (chunk > FP_INVAL_BATCH)
+                chunk = FP_INVAL_BATCH;
+            n += fp_invalidate_tags(c, tags + off, taglens + off, chunk);
+        }
+        return n;
+    }
+    uint64_t hashes[FP_INVAL_BATCH];
+    int nh = 0;
+    for (int t = 0; t < ntags; t++) {
+        if (taglens[t] == 0 || taglens[t] > FP_MAX_TAG)
+            continue;
+        hashes[nh++] = fp_hash(tags[t], taglens[t]);
+    }
+    if (nh == 0)
         return 0;
-    uint64_t h = fp_hash(tag, taglen);
     uint32_t n = 0;
     if (c->n_entries > 0) {
         for (uint32_t i = 0; i <= c->mask; i++) {
             fp_entry_t *e = &c->slots[i];
-            if (e->used && e->has_tag && e->taghash == h) {
-                fp_entry_free(c, e);
-                n++;
+            if (!e->used || !e->has_tag)
+                continue;
+            for (int t = 0; t < nh; t++) {
+                if (e->taghash == hashes[t]) {
+                    fp_entry_free(c, e);
+                    n++;
+                    break;
+                }
             }
         }
     }
-    if (c->zmain.n > 0 && taglen + 4 <= FP_MAX_KEY) {
+    if (c->zmain.n > 0) {
         static const uint16_t qtypes[2] = {1, 12};   /* A, PTR */
         uint8_t zkey[FP_MAX_KEY];
-        zkey[2] = 0;
-        zkey[3] = 1;                                 /* class IN */
-        memcpy(zkey + 4, tag, taglen);
-        for (int q = 0; q < 2; q++) {
-            zkey[0] = (uint8_t)(qtypes[q] >> 8);
-            zkey[1] = (uint8_t)(qtypes[q] & 0xFF);
-            fp_zentry_t *e = fp_ztab_find(&c->zmain, zkey, taglen + 4);
-            if (e != NULL && e->has_tag && e->taghash == h) {
-                fp_zentry_free(c, &c->zmain, e);
-                n++;
+        int hi = 0;
+        for (int t = 0; t < ntags; t++) {
+            size_t taglen = taglens[t];
+            if (taglen == 0 || taglen > FP_MAX_TAG)
+                continue;
+            uint64_t h = hashes[hi++];
+            if (taglen + 4 > FP_MAX_KEY)
+                continue;
+            zkey[2] = 0;
+            zkey[3] = 1;                             /* class IN */
+            memcpy(zkey + 4, tags[t], taglen);
+            for (int q = 0; q < 2; q++) {
+                zkey[0] = (uint8_t)(qtypes[q] >> 8);
+                zkey[1] = (uint8_t)(qtypes[q] & 0xFF);
+                fp_zentry_t *e = fp_ztab_find(&c->zmain, zkey,
+                                              taglen + 4);
+                if (e != NULL && e->has_tag && e->taghash == h) {
+                    fp_zentry_free(c, &c->zmain, e);
+                    n++;
+                }
             }
         }
     }
@@ -795,14 +837,25 @@ fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
          * hosts) — cheap even under mirror-build invalidation storms */
         for (uint32_t i = 0; i <= c->zalien.mask; i++) {
             fp_zentry_t *e = &c->zalien.slots[i];
-            if (e->used && e->has_tag && e->taghash == h) {
-                fp_zentry_free(c, &c->zalien, e);
-                n++;
+            if (!e->used || !e->has_tag)
+                continue;
+            for (int t = 0; t < nh; t++) {
+                if (e->taghash == hashes[t]) {
+                    fp_zentry_free(c, &c->zalien, e);
+                    n++;
+                    break;
+                }
             }
         }
     }
     c->invalidations += n;
     return n;
+}
+
+static inline uint32_t
+fp_invalidate_tag(fp_cache_t *c, const uint8_t *tag, size_t taglen)
+{
+    return fp_invalidate_tags(c, &tag, &taglen, 1);
 }
 
 /*

@@ -439,6 +439,60 @@ class TestZoneCoherence:
 
         asyncio.run(run())
 
+    def test_mutation_burst_bounded_drain_stays_fresh(self):
+        """A burst of mutations larger than the zone drain batch (r5
+        churn coalescing): answers must be FRESH immediately (raw-lane /
+        generic fallback while the name's re-push is still queued in the
+        dirty set) and zone-served again once the bounded drain catches
+        up — never stale in between."""
+        async def run():
+            store, cache = fixture_store()
+            n = BinderServer._ZONE_DRAIN_BATCH * 2 + 10
+            for i in range(n):
+                store.put_json(f"/com/foo/h{i}",
+                               {"type": "host",
+                                "host": {"address": f"10.7.{i // 250}.{i % 250 + 1}"}})
+            server = await start_server(cache)
+            try:
+                # mutate every host in one synchronous burst
+                for i in range(n):
+                    store.put_json(f"/com/foo/h{i}",
+                                   {"type": "host",
+                                    "host": {"address":
+                                             f"10.8.{i // 250}.{i % 250 + 1}"}})
+                assert len(server._zone_dirty) >= n
+                # immediately (zero loop turns for the drain to run a
+                # full catch-up): every answer must already be the NEW
+                # address, whatever path serves it
+                for i in (0, n // 2, n - 1):
+                    r = Message.decode(await udp_ask_raw(
+                        server.udp_port,
+                        make_query(f"h{i}.foo.com", Type.A,
+                                   qid=i).encode()))
+                    assert r.answers[0].address == \
+                        f"10.8.{i // 250}.{i % 250 + 1}"
+                # let the bounded drain finish, then everything is
+                # zone-served again
+                for _ in range(10):
+                    if not server._zone_dirty:
+                        break
+                    await asyncio.sleep(0)
+                assert not server._zone_dirty
+                assert not server._zone_drain_pending
+                before = zone_stats(server)["zone_hits"]
+                for i in (1, n - 2):
+                    r = Message.decode(await udp_ask_raw(
+                        server.udp_port,
+                        make_query(f"h{i}.foo.com", Type.A,
+                                   qid=1000 + i).encode()))
+                    assert r.answers[0].address == \
+                        f"10.8.{i // 250}.{i % 250 + 1}"
+                assert zone_stats(server)["zone_hits"] == before + 2
+            finally:
+                await server.stop()
+
+        asyncio.run(run())
+
     def test_deleted_node_falls_back_to_python_refused(self):
         async def run():
             store, cache = fixture_store()
