@@ -32,7 +32,8 @@ class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
                  "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
-                 "cached_summary", "no_store", "dep_domain")
+                 "cached_summary", "no_store", "dep_domain",
+                 "want_log_detail")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -62,6 +63,11 @@ class QueryCtx:
         # SRV, reverse qname for PTR) — the answer cache's per-name
         # invalidation tag
         self.dep_domain: Optional[str] = None
+        # set by the server when per-query logging is on: response paths
+        # that shortcut record decoding (the recursion raw splice) must
+        # instead take the decoding path so log lines keep full answer
+        # summaries
+        self.want_log_detail = False
         self._responded = False
         self.bytes_sent = 0
         self.start = time.monotonic()

@@ -38,6 +38,12 @@ except ImportError:  # pure-Python fallback: recvfrom/sendto per packet
 # the extension predate it)
 _fp_serve_wire = getattr(_fastio, "fastpath_serve_wire", None)
 
+# Sentinel an on_query hook may return instead of an awaitable: the
+# query is in flight and the HANDLER owns its completion — response AND
+# after-hook — via its own future callbacks (the recursion fast path).
+# The engine then creates no task for it.
+HANDLED_ASYNC = object()
+
 BALANCER_VERSION = 1
 BALANCER_HDR = 21  # version + family + transport + 16-byte addr + port
 MAX_FRAME = 65_556
@@ -204,6 +210,8 @@ class DnsServer:
         if pending is None:
             self._after(query)
             return
+        if pending is HANDLED_ASYNC:
+            return    # handler completes (and runs after) via callbacks
         task = asyncio.ensure_future(self._run_async(query, pending))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
@@ -444,6 +452,36 @@ class DnsServer:
         log = self.log
         burst = self._UDP_BURST
         batch_out: List[Optional[list]] = [None]  # non-None while draining
+        # Late (async-completed) responses — the recursion path — are
+        # coalesced per event-loop pass into one sendmmsg instead of a
+        # sendto syscall each: upstream answers arrive in batches on the
+        # upstream socket, so their completions cluster in one pass.
+        late_out: list = []
+
+        def flush_late() -> None:
+            out = late_out[:]
+            late_out.clear()
+            try:
+                sent = send_batch(fd, out)
+                if sent < len(out):
+                    sent += send_batch(fd, out[sent:])
+                    if sent < len(out):
+                        log.debug("dropped %d late UDP responses "
+                                  "(send buffer full)", len(out) - sent)
+            except OSError as e:
+                log.error("batched late UDP send failed: %s", e)
+
+        def send_late(wire: bytes, addr) -> None:
+            if not late_out:
+                try:
+                    asyncio.get_running_loop().call_soon(flush_late)
+                except RuntimeError:
+                    try:
+                        sendto(wire, addr)
+                    except OSError as e:
+                        log.debug("UDP send to %s failed: %s", addr, e)
+                    return
+            late_out.append((wire, addr))
 
         def on_readable() -> None:
             out: list = []
@@ -477,11 +515,7 @@ class DnsServer:
                             if cur is not None:
                                 cur.append((wire, _addr))
                             else:   # late (async) response
-                                try:
-                                    sendto(wire, _addr)
-                                except OSError as e:
-                                    log.debug("UDP send to %s failed: %s",
-                                              _addr, e)
+                                send_late(wire, _addr)
                         try:
                             handle_raw(data, addr, "udp", send)
                         except Exception:

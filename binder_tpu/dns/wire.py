@@ -604,6 +604,61 @@ class Message:
         return MAX_UDP_PAYLOAD
 
 
+def skip_name(buf: bytes, off: int) -> Optional[int]:
+    """Offset just past a wire name at ``off`` — labels walked, a
+    compression pointer consumed as the 2-byte terminator it is; None
+    on malformed/overrun.  Structural only (no decompression): used by
+    consumers that forward or validate wires without decoding them."""
+    n = len(buf)
+    while True:
+        if off >= n:
+            return None
+        b = buf[off]
+        if b == 0:
+            return off + 1
+        if b & 0xC0 == 0xC0:
+            return off + 2 if off + 2 <= n else None
+        if b & 0xC0:
+            return None
+        off += 1 + b
+
+
+def skip_record(buf: bytes, off: int) -> Optional[Tuple[int, int]]:
+    """(next_offset, rtype) for the record at ``off``; None on bounds."""
+    noff = skip_name(buf, off)
+    if noff is None or noff + 10 > len(buf):
+        return None
+    rtype = (buf[noff] << 8) | buf[noff + 1]
+    rdlen = (buf[noff + 8] << 8) | buf[noff + 9]
+    end = noff + 10 + rdlen
+    if end > len(buf):
+        return None
+    return end, rtype
+
+
+def wire_walks(raw: bytes) -> bool:
+    """True when the message's section counts walk the wire cleanly to
+    its exact end — the structural validation applied to upstream
+    responses before they can win a lookup (a full decode happens only
+    on paths that need record objects)."""
+    if len(raw) < 12:
+        return False
+    counts = ((raw[4] << 8) | raw[5], (raw[6] << 8) | raw[7],
+              (raw[8] << 8) | raw[9], (raw[10] << 8) | raw[11])
+    off = 12
+    for _ in range(counts[0]):
+        noff = skip_name(raw, off)
+        if noff is None or noff + 4 > len(raw):
+            return False
+        off = noff + 4
+    for _ in range(counts[1] + counts[2] + counts[3]):
+        nxt = skip_record(raw, off)
+        if nxt is None:
+            return False
+        off = nxt[0]
+    return off == len(raw)
+
+
 def make_query(name: str, qtype: int, *, qid: int = 0, rd: bool = False,
                edns_payload: Optional[int] = 1232) -> Message:
     """Build a standard query message (client side / tests)."""

@@ -16,7 +16,8 @@ import logging
 import random
 from typing import List, Optional, Sequence, Tuple
 
-from binder_tpu.dns.wire import Message, Rcode, Record, make_query
+from binder_tpu.dns.wire import (Message, Rcode, Record,
+                                 make_query, wire_walks)
 from binder_tpu.utils.endpoints import parse_endpoint
 
 DEFAULT_TIMEOUT = 3.0  # lib/recursion.js:257
@@ -44,13 +45,37 @@ class _PortProto(asyncio.DatagramProtocol):
     and the real answer keeps being awaited."""
 
     def __init__(self) -> None:
-        self.pending: dict = {}
+        self.pending: dict = {}         # qid -> (fut, expect_q, deadline)
         self.transport = None
         self.case_mismatch_drops = 0
         self.log = logging.getLogger("binder.dnsclient")
+        # Timeout handling is a periodic deadline sweep over `pending`
+        # instead of one wait_for timer per query: the forwarding hot
+        # path creates/cancels zero timer handles, and a sweep over a
+        # small dict every ~quarter second is noise.
+        self._sweep_handle = None
 
     def connection_made(self, transport) -> None:
         self.transport = transport
+
+    def _arm_sweep(self, loop, interval: float) -> None:
+        if self._sweep_handle is None:
+            self._sweep_handle = loop.call_later(interval, self._sweep,
+                                                 loop, interval)
+
+    def _sweep(self, loop, interval: float) -> None:
+        self._sweep_handle = None
+        if self.transport is None or self.transport.is_closing():
+            return
+        now = loop.time()
+        expired = [qid for qid, (_f, _q, dl) in self.pending.items()
+                   if dl <= now]
+        for qid in expired:
+            fut, _q, _dl = self.pending.pop(qid)
+            if not fut.done():
+                fut.set_exception(WireTimeout("upstream timeout"))
+        if self.pending:
+            self._arm_sweep(loop, interval)
 
     def datagram_received(self, data, addr) -> None:
         if len(data) < 12:
@@ -58,7 +83,7 @@ class _PortProto(asyncio.DatagramProtocol):
         entry = self.pending.get((data[0] << 8) | data[1])
         if entry is None:
             return                      # late/duplicate response
-        fut, expect_q = entry
+        fut, expect_q, _deadline = entry
         if fut.done():
             return
         # verbatim question echo (id + 0x20 case mask) or it's not ours
@@ -75,18 +100,19 @@ class _PortProto(asyncio.DatagramProtocol):
                     "(0x20-incompatible upstream, or spoofed traffic)", n)
             return
         del self.pending[(data[0] << 8) | data[1]]
-        try:
-            msg = Message.decode(data)
-        except Exception as e:  # noqa: BLE001 — malformed upstream bytes
-            fut.set_exception(WireTimeout(f"bad upstream response: {e}"))
-            return
-        fut.set_result(msg)
+        # validated raw bytes (id + verbatim question echo); decoding is
+        # deferred to the consumer — the splice path (recursion.py)
+        # forwards the wire without ever building record objects
+        fut.set_result(bytes(data))
 
     def _fail_all(self, exc) -> None:
-        for fut, _q in self.pending.values():
+        for fut, _q, _dl in self.pending.values():
             if not fut.done():
                 fut.set_exception(exc)
         self.pending.clear()
+        if self._sweep_handle is not None:
+            self._sweep_handle.cancel()
+            self._sweep_handle = None
 
     def error_received(self, exc) -> None:
         # ICMP errors carry no query attribution on a connected socket;
@@ -120,6 +146,11 @@ class DnsClient:
     in-flight query (id-multiplexed) — per-query socket creation would
     dominate the forwarding path's cost and churn ephemeral ports."""
 
+    #: encoded-query templates kept per (name, qtype) — forwarders
+    #: re-ask the same names continuously, and make_query+encode per
+    #: forward costs more than the rest of the client path combined
+    _TMPL_MAX = 4096
+
     def __init__(self, concurrency: int = 2,
                  timeout: float = DEFAULT_TIMEOUT,
                  log: Optional[logging.Logger] = None) -> None:
@@ -130,6 +161,37 @@ class DnsClient:
         # died or the entry belongs to a previous event loop (tests run
         # several loops in one process)
         self._ports: dict = {}
+        self._tmpl: dict = {}
+        self._resolver_keys: dict = {}   # "ip:port" -> (host, port)
+
+    def _build_wire(self, name: str, qtype: int,
+                    qid: int) -> Tuple[bytearray, int]:
+        """Query wire for one send: template (cached per name/qtype,
+        RD=0, qid 0) + this send's qid + a fresh dns0x20 case mask.
+        Returns (wire, qname_end_offset)."""
+        key = (name, qtype)
+        tmpl = self._tmpl.get(key)
+        if tmpl is None:
+            tmpl = make_query(name, qtype, qid=0, rd=False).encode()
+            if len(self._tmpl) >= self._TMPL_MAX:
+                self._tmpl.pop(next(iter(self._tmpl)))
+            self._tmpl[key] = tmpl
+        wire = bytearray(tmpl)
+        wire[0] = qid >> 8
+        wire[1] = qid & 0xFF
+        # dns0x20: random case mask over the qname's alpha bytes (the
+        # encoder emits lowercase; a fresh query's qname sits at offset
+        # 12, uncompressed); the response must echo these exact bytes.
+        # One getrandbits call covers the whole name.
+        mask = random.getrandbits(256)
+        off = 12
+        while wire[off] != 0:
+            ll = wire[off]
+            for i in range(off + 1, off + 1 + ll):
+                if 0x61 <= wire[i] <= 0x7A and (mask >> (i - 12)) & 1:
+                    wire[i] -= 0x20
+            off += 1 + ll
+        return wire, off
 
     async def _get_port(self, host: str, port: int) -> _PortProto:
         loop = asyncio.get_running_loop()
@@ -175,17 +237,41 @@ class DnsClient:
                      resolvers: Sequence[str],
                      error_threshold: Optional[int] = None
                      ) -> List[Record]:
-        """Return the answers from the first NOERROR upstream response.
+        """Return the answers from the first NOERROR upstream response
+        (decoded-record spelling; the forwarding hot path uses
+        :meth:`lookup_raw` and never builds record objects)."""
+        raw = await self.lookup_raw(name, qtype, resolvers,
+                                    error_threshold)
+        try:
+            return Message.decode(raw).answers
+        except Exception as e:  # noqa: BLE001 — malformed upstream bytes
+            raise UpstreamError(f"bad upstream response: {e}")
 
-        Tries *resolvers* with at most ``concurrency`` queries in flight;
-        gives up once ``error_threshold`` upstreams have failed (default:
-        all of them, matching mname-client's behavior of walking the whole
-        list).
+    async def lookup_raw(self, name: str, qtype: int,
+                         resolvers: Sequence[str],
+                         error_threshold: Optional[int] = None
+                         ) -> bytes:
+        """Return the first NOERROR upstream response as validated raw
+        wire bytes.
+
+        Validation is the id-multiplex + dns0x20 verbatim question echo
+        (\\_PortProto) plus the header rcode/tc checks here; body
+        structure is checked by whoever consumes the bytes (the splice
+        walker, or Message.decode on the rebuild path).  Tries
+        *resolvers* with at most ``concurrency`` queries in flight;
+        gives up once ``error_threshold`` upstreams have failed
+        (default: all of them, matching mname-client's behavior of
+        walking the whole list).
         """
         if not resolvers:
             raise UpstreamError("no upstream resolvers")
         threshold = (len(resolvers) if error_threshold is None
                      else error_threshold)
+
+        if len(resolvers) == 1:
+            # single upstream (the common cross-DC forward): skip the
+            # semaphore/task fan-out machinery entirely
+            return await self._lookup_one_raw(name, qtype, resolvers[0])
 
         sem = asyncio.Semaphore(self.concurrency)
         errors: List[str] = []
@@ -198,37 +284,47 @@ class DnsClient:
                     if winner.done():
                         return
                     try:
-                        msg = await self._query_one(name, qtype, resolver)
+                        raw = await self._query_one(name, qtype, resolver)
                     except Exception as e:  # noqa: BLE001 — any failure
                         # counts against the threshold; an uncounted error
                         # (e.g. a malformed resolver string) would hang
                         # the lookup forever
                         errors.append(f"{resolver}: {e}")
                     else:
-                        if msg.rcode == Rcode.NOERROR and msg.tc:
+                        rcode = raw[3] & 0x0F
+                        tc = bool(raw[2] & 0x02)
+                        if rcode == Rcode.NOERROR and tc:
                             # truncated: retry the same resolver over
                             # TCP before counting it as a failure
                             # (mname-client capability the reference
                             # relies on for large PTR/SRV answer sets,
                             # lib/recursion.js:253-279)
                             try:
-                                msg = await self._query_one_tcp(
+                                raw = await self._query_one_tcp(
                                     name, qtype, resolver)
+                                rcode = raw[3] & 0x0F
+                                tc = bool(raw[2] & 0x02)
                             except Exception as e:  # noqa: BLE001
                                 errors.append(
                                     f"{resolver}: tcp retry: {e}")
-                                msg = None
-                        if (msg is not None
-                                and msg.rcode == Rcode.NOERROR
-                                and not msg.tc):
-                            if not winner.done():
-                                winner.set_result(msg.answers)
-                            return
-                        if msg is not None:
+                                raw = None
+                        if (raw is not None
+                                and rcode == Rcode.NOERROR and not tc):
+                            # structural walk before the response can
+                            # win the race: a body-malformed NOERROR
+                            # must count as ONE resolver error, not
+                            # fail the whole lookup
+                            if wire_walks(raw):
+                                if not winner.done():
+                                    winner.set_result(raw)
+                                return
+                            errors.append(f"{resolver}: malformed body")
+                            raw = None
+                        if raw is not None:
                             errors.append(
                                 f"{resolver}: "
-                                + ("truncated" if msg.tc
-                                   else f"rcode {Rcode.name(msg.rcode)}"))
+                                + ("truncated" if tc
+                                   else f"rcode {Rcode.name(rcode)}"))
                     if len(errors) >= threshold and not winner.done():
                         winner.set_exception(UpstreamError(
                             "; ".join(errors[-4:])))
@@ -246,35 +342,89 @@ class DnsClient:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
 
+    def query_future(self, name: str, qtype: int,
+                     resolver: str) -> Optional[asyncio.Future]:
+        """Zero-coroutine send: build + send the query on the pooled
+        port synchronously and return the response future (resolved by
+        the shared protocol, timed out by its deadline sweep).  Returns
+        None when the pooled port isn't ready (first query to an
+        upstream, dead transport) — the caller takes the coroutine path,
+        which (re)creates the port."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return None
+        key = self._resolver_keys.get(resolver)
+        if key is None:
+            try:
+                key = _parse_resolver(resolver)
+            except ValueError:
+                return None
+            if len(self._resolver_keys) >= self._TMPL_MAX:
+                self._resolver_keys.pop(next(iter(self._resolver_keys)))
+            self._resolver_keys[resolver] = key
+        entry = self._ports.get(key)
+        if entry is None:
+            return None
+        e_loop, proto = entry
+        if (e_loop is not loop or proto.transport is None
+                or proto.transport.is_closing()):
+            return None
+        qid = random.getrandbits(16)
+        while qid in proto.pending:
+            qid = random.getrandbits(16)
+        wire, off = self._build_wire(name, qtype, qid)
+        fut: asyncio.Future = loop.create_future()
+        proto.pending[qid] = (fut, bytes(wire[12:off + 5]),
+                              loop.time() + self.timeout)
+        proto._arm_sweep(loop, min(self.timeout / 2, 0.25))
+        proto.transport.sendto(wire)
+        return fut
+
+    async def _lookup_one_raw(self, name: str, qtype: int,
+                              resolver: str) -> bytes:
+        """Single-upstream lookup with the same NOERROR/tc-retry policy
+        as the fan-out path."""
+        try:
+            raw = await self._query_one(name, qtype, resolver)
+        except Exception as e:  # noqa: BLE001 — same accounting as one()
+            raise UpstreamError(f"{resolver}: {e}")
+        rcode = raw[3] & 0x0F
+        tc = bool(raw[2] & 0x02)
+        if rcode == Rcode.NOERROR and tc:
+            try:
+                raw = await self._query_one_tcp(name, qtype, resolver)
+            except Exception as e:  # noqa: BLE001
+                raise UpstreamError(f"{resolver}: tcp retry: {e}")
+            rcode = raw[3] & 0x0F
+            tc = bool(raw[2] & 0x02)
+        if rcode == Rcode.NOERROR and not tc:
+            if wire_walks(raw):
+                return raw
+            raise UpstreamError(f"{resolver}: malformed body")
+        raise UpstreamError(
+            f"{resolver}: "
+            + ("truncated" if tc else f"rcode {Rcode.name(rcode)}"))
+
     async def _query_one(self, name: str, qtype: int,
-                         resolver: str) -> Message:
+                         resolver: str) -> bytes:
         host, port = _parse_resolver(resolver)
         proto = await self._get_port(host, port)
         loop = asyncio.get_running_loop()
         # qid must be unique among this upstream's in-flight queries
-        qid = random.randrange(0, 65536)
+        qid = random.getrandbits(16)
         while qid in proto.pending:
-            qid = random.randrange(0, 65536)
-        # Forwarded queries must not re-recurse: clear RD
+            qid = random.getrandbits(16)
+        # Forwarded queries must not re-recurse: RD=0 in the template
         # (lib/recursion.js:259-261)
-        query = make_query(name, qtype, qid=qid, rd=False)
-        wire = bytearray(query.encode())
-        # dns0x20: random case mask over the qname's alpha bytes (the
-        # encoder emits lowercase; a fresh query's qname sits at offset
-        # 12, uncompressed); the response must echo these exact bytes
-        off = 12
-        while wire[off] != 0:
-            ll = wire[off]
-            for i in range(off + 1, off + 1 + ll):
-                if 0x61 <= wire[i] <= 0x7A and random.getrandbits(1):
-                    wire[i] -= 0x20
-            off += 1 + ll
+        wire, off = self._build_wire(name, qtype, qid)
         expect_q = bytes(wire[12:off + 5])   # qname + terminator + type/class
         fut: asyncio.Future = loop.create_future()
-        proto.pending[qid] = (fut, expect_q)
+        proto.pending[qid] = (fut, expect_q, loop.time() + self.timeout)
+        proto._arm_sweep(loop, min(self.timeout / 2, 0.25))
         try:
-            proto.transport.sendto(bytes(wire))
-            return await asyncio.wait_for(fut, self.timeout)
+            proto.transport.sendto(wire)
+            return await fut
         finally:
             # pop only our own entry: after this qid was released (answer
             # delivered / socket failed), another query may have re-used
@@ -284,24 +434,24 @@ class DnsClient:
                 del proto.pending[qid]
 
     async def _query_one_tcp(self, name: str, qtype: int,
-                             resolver: str) -> Message:
+                             resolver: str) -> bytes:
         """RFC 1035 §4.2.2 framed query — the truncation fallback."""
         host, port = _parse_resolver(resolver)
         qid = random.randrange(0, 65536)
         query = make_query(name, qtype, qid=qid, rd=False)
         wire = query.encode()
 
-        async def go() -> Message:
+        async def go() -> bytes:
             reader, writer = await asyncio.open_connection(host, port)
             try:
                 writer.write(len(wire).to_bytes(2, "big") + wire)
                 await writer.drain()
                 hdr = await reader.readexactly(2)
                 n = int.from_bytes(hdr, "big")
-                msg = Message.decode(await reader.readexactly(n))
-                if msg.id != qid:
+                raw = await reader.readexactly(n)
+                if n < 12 or ((raw[0] << 8) | raw[1]) != qid:
                     raise WireTimeout("upstream TCP answer id mismatch")
-                return msg
+                return raw
             finally:
                 writer.close()
                 try:

@@ -34,13 +34,18 @@ from binder_tpu.dns.wire import (
     AAAARecord,
     ARecord,
     CNAMERecord,
+    Message,
     PTRRecord,
     Rcode,
     Record,
     SRVRecord,
     TXTRecord,
     Type,
+    WireError,
+    skip_name,
+    skip_record,
 )
+from binder_tpu.dns.server import HANDLED_ASYNC
 from binder_tpu.recursion.client import DnsClient, UpstreamError
 from binder_tpu.utils import netif
 
@@ -120,6 +125,10 @@ class Recursion:
         self.nsc_max = ptr_client or DnsClient(concurrency=PTR_CONCURRENCY)
 
         self.dcs: Dict[str, List[str]] = {}
+        # set by the owning server (engine._after): enables the
+        # zero-coroutine fast path, whose future callback must run the
+        # metrics/log after-hook itself
+        self.engine_after = None
         self._ready = asyncio.Event()
         self._nics: Optional[List[str]] = None
         self._nics_at = 0.0
@@ -136,6 +145,15 @@ class Recursion:
     def _spawn(self, coro) -> None:
         task = asyncio.ensure_future(coro)
         self._bg.append(task)
+        # completed tasks must not accumulate (the truncation-retry
+        # path spawns per query)
+        task.add_done_callback(self._bg_discard)
+
+    def _bg_discard(self, task) -> None:
+        try:
+            self._bg.remove(task)
+        except ValueError:
+            pass
 
     async def wait_ready(self) -> None:
         if not self._bg and not self._ready.is_set():
@@ -209,7 +227,112 @@ class Recursion:
             self._nics_at = now
         return self._nics
 
-    async def resolve(self, query: QueryCtx) -> None:
+    def resolve(self, query: QueryCtx):
+        """Entry point from the engine's recursion handoff.
+
+        The dominant shape — forward query, one live upstream for the
+        target DC, pooled port ready — is dispatched with ZERO coroutine
+        machinery: the query goes out synchronously and a future
+        callback completes it (splice-or-rebuild + respond + the
+        engine's after hook), returning ``HANDLED_ASYNC``.  Everything
+        else (PTR fan-out, multi-upstream DCs, cold ports, truncation
+        retries) returns the coroutine the engine drives as a task."""
+        if self.engine_after is not None and query.qtype() != Type.PTR:
+            domain = query.name().lower()
+            if domain.endswith(self.dns_domain):
+                prefix = domain[:len(domain) - len(self.dns_domain) - 1]
+                dc = prefix[prefix.rfind(".") + 1:]
+                ups = self.dcs.get(dc)
+                if ups is not None and len(ups) == 1 \
+                        and _host_of(ups[0]) not in self._my_addrs():
+                    fut = self.nsc.query_future(domain, query.qtype(),
+                                                ups[0])
+                    if fut is not None:
+                        fut.add_done_callback(
+                            lambda f: self._complete(query, domain, f))
+                        return HANDLED_ASYNC
+        return self._resolve_slow(query)
+
+    def _complete(self, query: QueryCtx, domain: str,
+                  fut: "asyncio.Future") -> None:
+        """Future callback finishing a fast-path forward: splice the
+        validated upstream wire, or decode+rebuild for shapes the
+        splice declines, or REFUSED on upstream failure — then run the
+        engine's after hook (metrics/log)."""
+        try:
+            exc = fut.exception()
+            raw_up = None if exc is not None else fut.result()
+            if raw_up is not None:
+                rcode = raw_up[3] & 0x0F
+                if raw_up[2] & 0x02 and rcode == Rcode.NOERROR:
+                    # truncated: the TCP retry needs real async — hand
+                    # the rare path to a task
+                    self._spawn(self._finish_tcp(query, domain))
+                    return
+                if rcode != Rcode.NOERROR:
+                    raw_up = None       # REFUSED shape below
+            self._finish_wire(query, domain, raw_up)
+        except Exception:  # noqa: BLE001 — callback context: must not leak
+            self.log.exception("recursion completion failed")
+            if not query.responded:
+                query.set_error(Rcode.SERVFAIL)
+                try:
+                    query.respond()
+                except OSError:
+                    pass
+            if self.engine_after is not None:
+                self.engine_after(query)
+
+    async def _finish_tcp(self, query: QueryCtx, domain: str) -> None:
+        raw_up = None
+        try:
+            raw_up = await self.nsc._query_one_tcp(
+                domain, query.qtype(), self._dc_upstream(domain))
+            if raw_up is not None and (raw_up[3] & 0x0F) != Rcode.NOERROR:
+                raw_up = None
+        except Exception as e:  # noqa: BLE001 — best-effort retry
+            self.log.debug("recursion tcp retry failed: %s", e)
+            raw_up = None
+        self._finish_wire(query, domain, raw_up)
+
+    def _dc_upstream(self, domain: str) -> str:
+        prefix = domain[:len(domain) - len(self.dns_domain) - 1]
+        dc = prefix[prefix.rfind(".") + 1:]
+        return self.dcs[dc][0]
+
+    def _finish_wire(self, query: QueryCtx, domain: str,
+                     raw_up: Optional[bytes]) -> None:
+        """Shared tail: splice / rebuild / REFUSED, then the after hook."""
+        answers: List[Record] = []
+        if raw_up is not None:
+            if self._try_splice(query, raw_up):
+                if self.engine_after is not None:
+                    self.engine_after(query)
+                return
+            try:
+                answers = Message.decode(raw_up).answers
+            except WireError as e:
+                self.log.warning("recursion: undecodable upstream "
+                                 "response (%s)", e)
+        self._respond_rebuilt(query, domain, answers)
+        if self.engine_after is not None:
+            self.engine_after(query)
+
+    def _respond_rebuilt(self, query: QueryCtx, domain: str,
+                         answers: List[Record]) -> None:
+        if not answers:
+            # see the REFUSED comment in the engine
+            query.set_error(Rcode.REFUSED)
+        else:
+            for rec in answers:
+                rebuilt = self._rebuild(domain, rec)
+                if rebuilt is not None:
+                    query.add_answer(rebuilt)
+            if not query.response.answers:
+                query.set_error(Rcode.REFUSED)
+        query.respond()
+
+    async def _resolve_slow(self, query: QueryCtx) -> None:
         # decode_name lowercases wire names already; normalize again in
         # case a caller hands us a hand-built query (0x20-style mixed case)
         domain = query.name().lower()
@@ -217,29 +340,16 @@ class Recursion:
 
         is_ptr = query.qtype() == Type.PTR
 
-        def respond() -> None:
-            if not answers:
-                # see the REFUSED comment in the engine
-                query.set_error(Rcode.REFUSED)
-            else:
-                for rec in answers:
-                    rebuilt = self._rebuild(domain, rec)
-                    if rebuilt is not None:
-                        query.add_answer(rebuilt)
-                if not query.response.answers:
-                    query.set_error(Rcode.REFUSED)
-            query.respond()
-
         if not is_ptr and not domain.endswith(self.dns_domain):
             # never forward names outside our domain to public DNS
-            respond()
+            self._respond_rebuilt(query, domain, answers)
             return
 
         if not is_ptr:
             prefix = domain[:len(domain) - len(self.dns_domain) - 1]
             dc = prefix[prefix.rfind(".") + 1:]
             if dc not in self.dcs:
-                respond()
+                self._respond_rebuilt(query, domain, answers)
                 return
             upstreams = list(self.dcs[dc])
         else:
@@ -249,18 +359,120 @@ class Recursion:
         upstreams = [u for u in upstreams
                      if _host_of(u) not in my_addrs]
         if not upstreams:
-            respond()
+            self._respond_rebuilt(query, domain, answers)
             return
 
         nsc = self.nsc_max if is_ptr else self.nsc
+        raw_up = None
         try:
-            answers = await nsc.lookup(
+            raw_up = await nsc.lookup_raw(
                 domain, query.qtype(), upstreams,
                 error_threshold=len(upstreams) if is_ptr else None)
         except UpstreamError as e:
             self.log.debug("recursion upstream error: %s", e)
-            answers = []
-        respond()
+        if raw_up is not None:
+            # Raw splice (the hot path): the upstream answer — already
+            # validated by id + dns0x20 question echo + NOERROR — is
+            # forwarded as wire bytes with this client's id, RD bit, and
+            # question case patched in, skipping decode and re-encode
+            # entirely.  The reference rebuilds every record per type
+            # per query (lib/recursion.js:299-323); splicing leaves the
+            # semantics identical (differential-tested, byte-equal for
+            # binder-shaped upstreams) at a fraction of the cost.
+            # Shapes the splice can't prove safe fall back to the
+            # decode+rebuild path below.
+            if self._try_splice(query, raw_up):
+                return
+            try:
+                answers = Message.decode(raw_up).answers
+            except WireError as e:
+                self.log.warning("recursion: undecodable upstream "
+                                 "response (%s)", e)
+                answers = []
+        self._respond_rebuilt(query, domain, answers)
+
+    def _try_splice(self, query: QueryCtx, up: bytes) -> bool:
+        """Forward the upstream wire directly: patch id + RD + question
+        case, keep (or strip) the EDNS OPT to match the client, send.
+
+        Returns False — leaving the decode+rebuild path authoritative —
+        for every shape it can't prove equivalent to the rebuild:
+        multi-question, authority records, non-OPT additionals (the
+        rebuild drops those), structural walk failures, a needed-but-
+        absent OPT, an answer that would exceed the client's UDP
+        ceiling, or a query whose log line needs decoded record detail
+        (the logged posture keeps full answer summaries)."""
+        raw = query.raw
+        req = query.request
+        if (raw is None or query.want_log_detail
+                or len(req.questions) != 1):
+            return False
+        if len(up) < 12 or up[4:6] != b"\x00\x01" \
+                or up[8:10] != b"\x00\x00":
+            return False                # question/authority shape
+        # walk the upstream question (uncompressed by construction —
+        # our client sent it; the echo was verified byte-exact)
+        q_end = skip_name(up, 12)
+        if q_end is None or q_end + 4 > len(up):
+            return False
+        q_end += 4
+        # client question section from the request wire: must be the
+        # same name modulo 0x20 case, same type/class, same length
+        cq_end = skip_name(raw, 12)
+        if cq_end is None or cq_end + 4 > len(raw):
+            return False
+        cq_end += 4
+        if cq_end != q_end \
+                or raw[12:cq_end].lower() != up[12:q_end].lower():
+            return False
+        ancount = (up[6] << 8) | up[7]
+        arcount = (up[10] << 8) | up[11]
+        pos = q_end
+        for _ in range(ancount):
+            nxt = skip_record(up, pos)
+            if nxt is None:
+                return False
+            pos = nxt[0]
+        opt_start = None
+        for i in range(arcount):
+            start = pos
+            nxt = skip_record(up, pos)
+            if nxt is None:
+                return False
+            pos, rtype = nxt
+            if rtype != Type.OPT:
+                # the rebuild path drops non-OPT additionals; splicing
+                # them through would diverge — decline
+                return False
+            if i != arcount - 1:
+                return False            # OPT must be the final record
+            opt_start = start
+        if pos != len(up):
+            return False                # trailing bytes
+        if req.edns is not None:
+            if opt_start is None:
+                return False            # rebuild would add the echo OPT
+            tail = up[q_end:]
+            new_ar = arcount
+        elif opt_start is not None:
+            tail = up[q_end:opt_start]  # client spoke no EDNS: strip
+            new_ar = arcount - 1
+        else:
+            tail = up[q_end:]
+            new_ar = arcount
+        # header: client id, upstream flags with the client's RD echoed
+        # (we forward with RD=0), counts with the OPT adjustment
+        flags2 = (up[2] & 0xFE) | (0x01 if req.rd else 0)
+        wire = (req.id.to_bytes(2, "big") + bytes((flags2, up[3]))
+                + up[4:10] + new_ar.to_bytes(2, "big")
+                + raw[12:q_end] + tail)
+        if query.udp_semantics and len(wire) > req.max_udp_payload():
+            return False                # truncation: rebuild path owns it
+        query.response.rcode = up[3] & 0x0F   # for metrics
+        query.log_ctx["spliced"] = True
+        query.stamp("pre-resp")
+        query.respond_raw(wire)
+        return True
 
     def _rebuild(self, domain: str, rec: Record) -> Optional[Record]:
         """Re-create the upstream answer under the original query name,

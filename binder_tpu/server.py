@@ -193,6 +193,10 @@ class BinderServer:
         self.resolver = Resolver(zk_cache, dns_domain=dns_domain,
                                  datacenter_name=datacenter_name,
                                  recursion=recursion, log=self.log)
+        if recursion is not None and hasattr(recursion, "engine_after"):
+            # arm the recursion fast path: its future callback completes
+            # the query AND runs the engine's after hook itself
+            recursion.engine_after = self._engine_after_hook
         self.engine = DnsServer(log=self.log, name=name,
                                 tcp_idle_timeout=tcp_idle_timeout,
                                 max_tcp_conns=max_tcp_conns,
@@ -334,10 +338,20 @@ class BinderServer:
         self.udp_port: Optional[int] = None
         self.tcp_port: Optional[int] = None
 
+    def _engine_after_hook(self, query: QueryCtx) -> None:
+        """After-hook entry for self-completing paths (the recursion
+        fast path) — identical semantics to the engine's post-task
+        _after call."""
+        self.engine._after(query)
+
     # -- query hook (lib/server.js:471-507); sync, may return an awaitable
     # for the recursion path (see DnsServer._dispatch) --
 
     def _on_query(self, query: QueryCtx):
+        if self.query_log:
+            # log lines need decoded answer summaries: response paths
+            # that would shortcut decoding (recursion splice) must not
+            query.want_log_detail = True
         if self.p_req_start.enabled:   # skip closure alloc when off
             self.p_req_start.fire(lambda: {
                 "id": query.request.id, "name": query.name(),

@@ -632,24 +632,46 @@ fastpath_invalidate_many(PyObject *self, PyObject *args)
     if (fast == NULL)
         return NULL;
     Py_ssize_t total = PySequence_Fast_GET_SIZE(fast);
-    unsigned long dropped = 0;
-    for (Py_ssize_t off = 0; off < total; off += FP_INVAL_BATCH) {
-        const uint8_t *tag_ptrs[FP_INVAL_BATCH];
-        size_t tag_lens[FP_INVAL_BATCH];
-        int n = 0;
-        for (; n < FP_INVAL_BATCH && off + n < total; n++) {
-            char *data;
-            Py_ssize_t dlen;
-            if (PyBytes_AsStringAndSize(
-                    PySequence_Fast_GET_ITEM(fast, off + n),
-                    &data, &dlen) < 0) {
-                Py_DECREF(fast);
-                return NULL;
-            }
-            tag_ptrs[n] = (const uint8_t *)data;
-            tag_lens[n] = (size_t)dlen;
+    if (total > INT_MAX)
+        total = INT_MAX;
+    /* borrow all tag pointers once; fp_invalidate_tags chunks oversize
+     * batches internally (stack arrays cover the common event sizes) */
+    const uint8_t *stack_ptrs[FP_INVAL_BATCH];
+    size_t stack_lens[FP_INVAL_BATCH];
+    const uint8_t **tag_ptrs = stack_ptrs;
+    size_t *tag_lens = stack_lens;
+    if (total > FP_INVAL_BATCH) {
+        tag_ptrs = (const uint8_t **)malloc(
+            (size_t)total * sizeof(*tag_ptrs));
+        tag_lens = (size_t *)malloc((size_t)total * sizeof(*tag_lens));
+        if (tag_ptrs == NULL || tag_lens == NULL) {
+            free((void *)tag_ptrs == (void *)stack_ptrs ? NULL
+                 : (void *)tag_ptrs);
+            free(tag_lens == stack_lens ? NULL : (void *)tag_lens);
+            Py_DECREF(fast);
+            return PyErr_NoMemory();
         }
-        dropped += fp_invalidate_tags(c, tag_ptrs, tag_lens, n);
+    }
+    for (Py_ssize_t i = 0; i < total; i++) {
+        char *data;
+        Py_ssize_t dlen;
+        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fast, i),
+                                    &data, &dlen) < 0) {
+            if (tag_ptrs != stack_ptrs) {
+                free((void *)tag_ptrs);
+                free(tag_lens);
+            }
+            Py_DECREF(fast);
+            return NULL;
+        }
+        tag_ptrs[i] = (const uint8_t *)data;
+        tag_lens[i] = (size_t)dlen;
+    }
+    unsigned long dropped = fp_invalidate_tags(c, tag_ptrs, tag_lens,
+                                               (int)total);
+    if (tag_ptrs != stack_ptrs) {
+        free((void *)tag_ptrs);
+        free(tag_lens);
     }
     Py_DECREF(fast);
     return PyLong_FromUnsignedLong(dropped);

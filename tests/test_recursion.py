@@ -38,7 +38,7 @@ async def start_remote(dc, ip):
     return server
 
 
-async def start_local(dcs, **rkw):
+async def start_local(dcs, server_kw=None, **rkw):
     """Local binder with empty cache + recursion to the given dc map."""
     store = FakeStore()
     cache = MirrorCache(store, DOMAIN)
@@ -52,7 +52,8 @@ async def start_local(dcs, **rkw):
     server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
                           datacenter_name="local", recursion=recursion,
                           host="127.0.0.1", port=0,
-                          collector=MetricsCollector())
+                          collector=MetricsCollector(),
+                          **(server_kw or {}))
     await server.start()
     return server, recursion
 
@@ -480,3 +481,178 @@ class TestServerCaseEcho:
         qlen = len("_pg._tcp.svc.foo.com") + 2 + 4
         assert raw[12:12 + qlen] == mangled[12:12 + qlen]
         assert Message.decode(raw).rcode == Rcode.NOERROR
+
+
+async def udp_ask_raw(port, wire, timeout=5.0):
+    """Send pre-built query bytes; return raw response bytes."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(wire)
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        return await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+
+
+class TestRawSplice:
+    """Round-5 forwarding hot path: the validated upstream wire is
+    forwarded with id/RD/question-case patched instead of decode +
+    rebuild (reference rebuilds per record type per query,
+    lib/recursion.js:299-323).  The differential contract: spliced and
+    rebuilt responses are byte-equal modulo the id bytes for every
+    shape the splice accepts; shapes it declines take the rebuild path
+    unchanged."""
+
+    @staticmethod
+    async def _pair(dcs):
+        """Two local binders over the same remote map: one in the
+        logged posture (want_log_detail forces the rebuild path), one
+        log-off (splices)."""
+        rebuilt, r1 = await start_local(
+            dcs, server_kw={"query_log": True})
+        spliced, r2 = await start_local(
+            dcs, server_kw={"query_log": False})
+        return rebuilt, r1, spliced, r2
+
+    def test_spliced_equals_rebuilt_modulo_id(self):
+        async def run():
+            remote = await start_remote("east", "10.9.9.9")
+            dcs = {"east": [f"127.0.0.1:{remote.udp_port}"]}
+            rebuilt, r1, spliced, r2 = await self._pair(dcs)
+            try:
+                for payload in (1232, None):
+                    qa = make_query("web.east.foo.com", Type.A, qid=101,
+                                    rd=True, edns_payload=payload).encode()
+                    qb = make_query("web.east.foo.com", Type.A, qid=202,
+                                    rd=True, edns_payload=payload).encode()
+                    ra = await udp_ask_raw(rebuilt.udp_port, qa)
+                    rb = await udp_ask_raw(spliced.udp_port, qb)
+                    assert ra[:2] == (101).to_bytes(2, "big")
+                    assert rb[:2] == (202).to_bytes(2, "big")
+                    assert ra[2:] == rb[2:], \
+                        f"payload={payload}: spliced != rebuilt"
+                    m = Message.decode(rb)
+                    assert m.rcode == Rcode.NOERROR
+                    assert m.rd            # client's RD echoed
+                    assert m.answers[0].address == "10.9.9.9"
+                    assert m.answers[0].ttl == 44
+                    assert (m.edns is not None) == (payload is not None)
+            finally:
+                await rebuilt.stop()
+                await spliced.stop()
+                await r1.close()
+                await r2.close()
+                await remote.stop()
+
+        asyncio.run(run())
+
+    def test_mixed_case_question_echoed(self):
+        async def run():
+            remote = await start_remote("east", "10.9.9.10")
+            dcs = {"east": [f"127.0.0.1:{remote.udp_port}"]}
+            _, r1, spliced, r2 = await self._pair(dcs)
+            await _.stop()
+            await r1.close()
+            try:
+                q = bytearray(make_query("web.east.foo.com", Type.A,
+                                         qid=7, rd=True).encode())
+                # uppercase a few qname bytes (dns0x20 client)
+                q[12 + 1] ^= 0x20
+                q[12 + 5] ^= 0x20
+                resp = await udp_ask_raw(spliced.udp_port, bytes(q))
+                # the spliced response must echo the client's exact
+                # question bytes, not our upstream query's case mask
+                qend = 12
+                while resp[qend] != 0:
+                    qend += 1 + resp[qend]
+                qend += 5
+                assert resp[12:qend] == bytes(q[12:qend])
+                m = Message.decode(resp)
+                assert m.answers[0].address == "10.9.9.10"
+            finally:
+                await spliced.stop()
+                await r2.close()
+                await remote.stop()
+
+        asyncio.run(run())
+
+    def test_srv_with_glue_declines_to_rebuild(self):
+        """An upstream SRV answer carries A additionals; the rebuild
+        path drops them (reference behavior), so the splice must
+        decline rather than diverge."""
+        async def run():
+            remote = await start_remote("east", "10.9.9.11")
+            # register a service with members under the east dc
+            # (remote fixture only has a host; build our own remote)
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/com/foo/east", {"type": "service",
+                                             "service": {"port": 53}})
+            store.put_json("/com/foo/east/svc", {
+                "type": "service",
+                "service": {"srvce": "_pg", "proto": "_tcp",
+                            "port": 5432}})
+            store.put_json("/com/foo/east/svc/m0",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": "10.9.9.12"}})
+            store.start_session()
+            remote2 = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                   datacenter_name="east",
+                                   host="127.0.0.1", port=0,
+                                   collector=MetricsCollector())
+            await remote2.start()
+            dcs = {"east": [f"127.0.0.1:{remote2.udp_port}"]}
+            rebuilt, r1, spliced, r2 = await self._pair(dcs)
+            try:
+                name = "_pg._tcp.svc.east.foo.com"
+                ra = await udp_ask(rebuilt.udp_port, name, Type.SRV)
+                rb = await udp_ask(spliced.udp_port, name, Type.SRV)
+                for m in (ra, rb):
+                    assert m.rcode == Rcode.NOERROR
+                    assert m.answers[0].port == 5432
+                    # glue dropped on BOTH paths (rebuild semantics)
+                    non_opt = [r for r in m.additionals
+                               if type(r).__name__ != "OPTRecord"]
+                    assert non_opt == []
+            finally:
+                await rebuilt.stop()
+                await spliced.stop()
+                await r1.close()
+                await r2.close()
+                await remote2.stop()
+                await remote.stop()
+
+        asyncio.run(run())
+
+    def test_ptr_spliced(self):
+        async def run():
+            remote = await start_remote("east", "10.9.9.13")
+            dcs = {"east": [f"127.0.0.1:{remote.udp_port}"]}
+            rebuilt, r1, spliced, r2 = await self._pair(dcs)
+            try:
+                name = "13.9.9.10.in-addr.arpa"
+                qa = make_query(name, Type.PTR, qid=11, rd=True).encode()
+                qb = make_query(name, Type.PTR, qid=22, rd=True).encode()
+                ra = await udp_ask_raw(rebuilt.udp_port, qa)
+                rb = await udp_ask_raw(spliced.udp_port, qb)
+                assert ra[2:] == rb[2:]
+                m = Message.decode(rb)
+                assert m.answers[0].target == "web.east.foo.com"
+            finally:
+                await rebuilt.stop()
+                await spliced.stop()
+                await r1.close()
+                await r2.close()
+                await remote.stop()
+
+        asyncio.run(run())
