@@ -310,11 +310,20 @@ class DnsClient:
                                 raw = None
                         if (raw is not None
                                 and rcode == Rcode.NOERROR and not tc):
-                            # structural walk before the response can
-                            # win the race: a body-malformed NOERROR
-                            # must count as ONE resolver error, not
-                            # fail the whole lookup
-                            if wire_walks(raw):
+                            # full decode before the response can win
+                            # the fan-out race: a body-malformed NOERROR
+                            # must count as ONE resolver error and let
+                            # another upstream win, not fail the lookup.
+                            # (The single-upstream fast path skips this
+                            # — with no alternative upstream, a decode
+                            # failure ends the same way either side.)
+                            ok = wire_walks(raw)
+                            if ok:
+                                try:
+                                    Message.decode(raw)
+                                except Exception:  # noqa: BLE001
+                                    ok = False
+                            if ok:
                                 if not winner.done():
                                     winner.set_result(raw)
                                 return

@@ -407,6 +407,12 @@ class Recursion:
         if (raw is None or query.want_log_detail
                 or len(req.questions) != 1):
             return False
+        if query.latency_ms() > 1000.0:
+            # the slow-query WARNING (SLOW_QUERY_MS) fires even with
+            # query_log off and needs decoded answer summaries — a
+            # forward that is ALREADY slow takes the rebuild path so
+            # its log line carries them
+            return False
         if len(up) < 12 or up[4:6] != b"\x00\x01" \
                 or up[8:10] != b"\x00\x00":
             return False                # question/authority shape

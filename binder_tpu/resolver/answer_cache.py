@@ -40,7 +40,7 @@ class AnswerCache:
         self.expiry_s = expiry_ms / 1000.0
         self.variants_cap = variants_cap
         # key -> [epoch, created, next_variant_idx, [value, ...],
-        #         complete, tag]
+        #         complete, tag, pushed]
         self._entries: Dict[object, list] = {}
         # dependency tag -> keys whose answers derive from it
         self._by_tag: Dict[str, Set[object]] = {}
@@ -102,9 +102,23 @@ class AnswerCache:
             old_key = next(iter(self._entries))
             self._drop(old_key, self._entries[old_key])
         self._entries[key] = [epoch, time.monotonic(), 0, [value],
-                              not rotatable, tag]
+                              not rotatable, tag, False]
         self._by_tag.setdefault(tag, set()).add(key)
         return not rotatable
+
+    def take_push(self, key, epoch: int):
+        """Claim a complete entry for promotion to the native fast
+        path: returns ``(variant_values, tag)`` exactly once (marking
+        the entry pushed), else None.  Promotion happens on an entry's
+        FIRST HIT, not at resolve time — one-shot names (the cache-cold
+        workload) then never pay the native push cost, while any name
+        asked twice is native from its third query on."""
+        e = self._entries.get(key)
+        if e is None or e[0] != epoch or e[6] or not (
+                e[4] or len(e[3]) >= self.variants_cap):
+            return None
+        e[6] = True
+        return e[3], e[5]
 
     def invalidate_tag(self, tag: str) -> int:
         """Drop every entry whose answer derives from ``tag``; returns

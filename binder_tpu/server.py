@@ -379,6 +379,13 @@ class BinderServer:
                 query.log_ctx["cached"] = True
                 query.cached_summary = (ans, add)
                 query.respond_raw(wire)
+                # promote-on-first-hit: a repeat proves the name is hot,
+                # so hand the entry to the C fast path NOW (resolve-time
+                # pushes made one-shot cold names pay the native-push
+                # cost for entries never served again)
+                if (query.udp_semantics and self._fastpath is not None
+                        and self._fastpath_active()):
+                    self._fastpath_push(key, self.zk_cache.epoch, query)
                 return None
 
         pending = self.resolver.handle(query)
@@ -397,18 +404,13 @@ class BinderServer:
             # (set by the resolver at its lookup points); immutable
             # shapes (out-of-suffix REFUSED, NOTIMP) never consulted the
             # store, but tagging them with their own qname is harmless —
-            # no mutation will ever emit it
+            # no mutation will ever emit it.  The native push happens at
+            # the entry's first HIT (promote-on-first-hit above), never
+            # here on the cold path.
             tag = query.dep_domain or q0.name
-            completed = self.answer_cache.put(
+            self.answer_cache.put(
                 key, epoch, (query.wire, ans, add),
                 rotatable=len(query.response.answers) > 1, tag=tag)
-            # push only while the C path can actually drain — with the
-            # gate closed (query_log on / probes attached) the native
-            # cache would just accumulate dead wires; after a runtime
-            # toggle it repopulates from misses within one expiry window
-            if (completed and self._fastpath is not None
-                    and query.udp_semantics and self._fastpath_active()):
-                self._fastpath_push(key, epoch, query, tag)
         return pending
 
     @staticmethod
@@ -893,22 +895,23 @@ class BinderServer:
                     self._zone_refresh(
                         ".".join(reversed(parts)) + ".in-addr.arpa")
 
-    def _fastpath_push(self, key, epoch: int, query: QueryCtx,
-                       tag: str) -> None:
-        """Hand a just-completed answer-cache entry to the native fast
-        path.  The C key is built from the request's raw qname bytes so
-        both key builders see identical input; names outside the
-        hostname charset (which Python decodes with replacement) are
-        skipped — they keep being served by the Python path."""
+    def _fastpath_push(self, key, epoch: int, query: QueryCtx) -> None:
+        """Promote an answer-cache entry to the native fast path (on
+        its first hit — see _on_query).  The C key is built from the
+        request's raw qname bytes so both key builders see identical
+        input; names outside the hostname charset (which Python decodes
+        with replacement) are skipped — they keep being served by the
+        Python path."""
+        claimed = self.answer_cache.take_push(key, epoch)
+        if claimed is None:
+            return
+        variants, tag = claimed
         ckey = self._fastpath_key(query)
         if ckey is None:
             return
         tag_wire = self._qname_wire(tag)
         if tag_wire is None:
             return                      # not invalidatable: keep in Python
-        variants = self.answer_cache.variants(key, epoch)
-        if not variants:
-            return
         wires = [v[0] for v in variants]
         frags = None
         if self._log_ring:
@@ -1087,6 +1090,26 @@ class BinderServer:
                 self._lane_finish(data, src, protocol, start, wire,
                                   wire[3] & 0x0F, edns, hit[1], hit[2],
                                   qtype=qtype_val, cached=True)
+                # promote-on-first-hit: the repeat proves the name hot;
+                # hand it to the C fast path so the next repeat never
+                # surfaces to Python
+                if (udp_sem and self._fastpath is not None
+                        and self._fastpath_active()):
+                    claimed = self.answer_cache.take_push(key, epoch)
+                    if claimed is not None:
+                        qname_low = data[12:q_end - 4].lower()
+                        ckey = _fastpath_key_parts(
+                            bool(rd_flag), edns, payload, qtype_val, 1,
+                            qname_low)
+                        try:
+                            _fastio.fastpath_put(
+                                self._fastpath, ckey, qtype_val, epoch,
+                                [v[0] for v in claimed[0]],
+                                int(self.answer_cache.expiry_s * 1000),
+                                qname_low)
+                        except (TypeError, ValueError, MemoryError) as e:
+                            self.log.debug("fastpath push skipped: %s",
+                                           e)
             except Exception:
                 # response already sent: never fall through to the
                 # generic path (it would answer a second time)
@@ -1145,10 +1168,10 @@ class BinderServer:
                         + struct.pack(">IH", ttl & 0xFFFFFFFF, 4)
                         + packed)
                 ancount = 1
-                # through _summarize so the log shape cannot drift from
-                # what the generic path records
-                ans = [self._summarize(
-                    ARecord(name=name, ttl=ttl, address=addr))]
+                # same string _summarize(ARecord) renders, through the
+                # one redaction helper, without the record-object round
+                # trip
+                ans = [f"{strip_suffix(dd_suffix, name)} A {addr}"]
         else:
             # PTR: mirrors Resolver.resolve_ptr exactly — note there is
             # NO dnsDomain suffix policy on the reverse tree
@@ -1193,10 +1216,10 @@ class BinderServer:
                             + struct.pack(">IH", ttl & 0xFFFFFFFF,
                                           len(tw)) + tw)
                     ancount = 1
-                    # through _summarize so the log shape cannot drift
-                    # from what the generic path records
-                    ans = [self._summarize(
-                        PTRRecord(name=name, ttl=ttl, target=target))]
+                    # the dict _summarize renders for PTR records,
+                    # without the record-object round trip
+                    ans = [{"type": "PTR", "name": name, "ttl": ttl,
+                            "target": target}]
 
         flags_out = 0x8400 | (0x0100 if rd_flag else 0) | rcode
         wire = (data[:2]
@@ -1215,7 +1238,9 @@ class BinderServer:
             if rcode != Rcode.SERVFAIL:
                 # cache entries carry a lowercased question so hits can
                 # splice in each requester's own case (generic hits do
-                # the same via QueryCtx._echo_question_case)
+                # the same via QueryCtx._echo_question_case).  The
+                # native push happens at the entry's first hit above
+                # (promote-on-first-hit), never on this cold path.
                 q_sec = data[12:q_end]
                 q_low = q_sec.lower()
                 cache_wire = (wire if q_sec == q_low
@@ -1223,23 +1248,9 @@ class BinderServer:
                 # lane answers (hit, miss-REFUSED, suffix-REFUSED) all
                 # depend on exactly this name; the qname doubles as the
                 # dependency tag
-                completed = self.answer_cache.put(
+                self.answer_cache.put(
                     key, epoch, (cache_wire, ans, []), rotatable=False,
                     tag=name)
-                if (completed and self._fastpath is not None and udp_sem
-                        and self._fastpath_active()):
-                    qname_low = data[12:q_end - 4].lower()
-                    ckey = _fastpath_key_parts(
-                        bool(rd_flag), edns, payload, qtype_val, 1,
-                        qname_low)
-                    try:
-                        _fastio.fastpath_put(
-                            self._fastpath, ckey, qtype_val, epoch,
-                            [cache_wire],
-                            int(self.answer_cache.expiry_s * 1000),
-                            qname_low)
-                    except (TypeError, ValueError, MemoryError) as e:
-                        self.log.debug("fastpath push skipped: %s", e)
         except Exception:
             # response already sent: never fall through to the generic
             # path (it would answer a second time)

@@ -283,25 +283,7 @@ async def start_server(cache, **kw):
     return server
 
 
-async def udp_ask_raw(port, wire, timeout=2.0):
-    loop = asyncio.get_running_loop()
-    fut = loop.create_future()
-
-    class Proto(asyncio.DatagramProtocol):
-        def connection_made(self, transport):
-            self.transport = transport
-            transport.sendto(wire)
-
-        def datagram_received(self, data, addr):
-            if not fut.done():
-                fut.set_result(data)
-
-    transport, _ = await loop.create_datagram_endpoint(
-        Proto, remote_addr=("127.0.0.1", port))
-    try:
-        return await asyncio.wait_for(fut, timeout)
-    finally:
-        transport.close()
+from tests.test_zone import udp_ask_raw  # shared raw-ask helper
 
 
 async def udp_ask(port, name, qtype, qid=4242):
@@ -323,6 +305,12 @@ class TestFastpathIntegration:
                 first = await udp_ask(server.udp_port, "web.foo.com",
                                       Type.A)
                 assert fp_hits(server) == 0     # miss populated the cache
+                # promote-on-first-hit (r5): the first repeat serves from
+                # the Python answer cache AND promotes; the next repeat
+                # is native
+                await udp_ask(server.udp_port, "web.foo.com", Type.A,
+                              qid=776)
+                assert fp_hits(server) == 0
                 second = await udp_ask(server.udp_port, "web.foo.com",
                                        Type.A, qid=777)
                 assert fp_hits(server) == 1
@@ -346,6 +334,9 @@ class TestFastpathIntegration:
                     await udp_ask(server.udp_port, "svc.foo.com", Type.A,
                                   qid=i + 1)
                 assert fp_hits(server) == 0
+                # first hit promotes (r5 promote-on-first-hit)
+                await udp_ask(server.udp_port, "svc.foo.com", Type.A,
+                              qid=99)
                 orderings = []
                 for i in range(cap):
                     m = await udp_ask(server.udp_port, "svc.foo.com",
@@ -366,6 +357,7 @@ class TestFastpathIntegration:
             store, cache = fixture_store()
             server = await start_server(cache)
             try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A)
                 await udp_ask(server.udp_port, "web.foo.com", Type.A)
                 await udp_ask(server.udp_port, "web.foo.com", Type.A)
                 assert fp_hits(server) == 1
@@ -400,7 +392,9 @@ class TestFastpathIntegration:
                 for i in range(5):
                     await udp_ask(server.udp_port, "web.foo.com", Type.A,
                                   qid=i + 1)
-                assert fp_hits(server) == 4
+                # r5 promote-on-first-hit: resolve, Python hit (promotes),
+                # then 3 native hits
+                assert fp_hits(server) == 3
                 text = server.collector.expose()
                 assert ('binder_requests_completed{type="A"} 5' in text)
                 assert ('binder_request_latency_seconds_count{type="A"} 5'
@@ -425,6 +419,8 @@ class TestFastpathIntegration:
                 prime = (b"\x00\x01\x00\x00\x00\x01\x00\x00\x00\x00"
                          b"\x00\x00" + lower + b"\x00\x01\x00\x01")
                 await udp_ask_raw(server.udp_port, prime)
+                # second ask promotes (r5 promote-on-first-hit)
+                await udp_ask_raw(server.udp_port, b"\x00\x02" + prime[2:])
                 mixed = b"\x03wEb\x03FoO\x03cOm\x00"
                 pkt = (b"\x77\x77\x00\x00\x00\x01\x00\x00\x00\x00\x00\x00"
                        + mixed + b"\x00\x01\x00\x01")
@@ -472,10 +468,11 @@ class TestFastpathIntegration:
             _, cache = fixture_store()
             server = await start_server(cache)
             try:
-                for i in range(2):
+                for i in range(3):
                     m = await udp_ask(server.udp_port, "nope.foo.com",
                                       Type.A, qid=i + 1)
                     assert m.rcode == Rcode.REFUSED
+                # r5 promote-on-first-hit: third repeat is the native one
                 assert fp_hits(server) == 1
             finally:
                 await server.stop()

@@ -144,6 +144,10 @@ class TestLogRing:
             try:
                 r1 = await udp_ask(server.udp_port, "x.example.com",
                                    Type.A, qid=200)
+                # first repeat promotes (r5 promote-on-first-hit); the
+                # next repeat is the native one
+                await udp_ask(server.udp_port, "x.example.com",
+                              Type.A, qid=205)
                 r2 = await udp_ask(server.udp_port, "x.example.com",
                                    Type.A, qid=201)
                 assert r1.rcode == r2.rcode == Rcode.REFUSED

@@ -483,25 +483,7 @@ class TestServerCaseEcho:
         assert Message.decode(raw).rcode == Rcode.NOERROR
 
 
-async def udp_ask_raw(port, wire, timeout=5.0):
-    """Send pre-built query bytes; return raw response bytes."""
-    loop = asyncio.get_running_loop()
-    fut = loop.create_future()
-
-    class Proto(asyncio.DatagramProtocol):
-        def connection_made(self, transport):
-            transport.sendto(wire)
-
-        def datagram_received(self, data, addr):
-            if not fut.done():
-                fut.set_result(data)
-
-    transport, _ = await loop.create_datagram_endpoint(
-        Proto, remote_addr=("127.0.0.1", port))
-    try:
-        return await asyncio.wait_for(fut, timeout)
-    finally:
-        transport.close()
+from tests.test_zone import udp_ask_raw  # shared raw-ask helper
 
 
 class TestRawSplice:

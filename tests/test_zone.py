@@ -793,7 +793,9 @@ class TestTruncationNotReplayedOverTcp:
                 # rotatable entries only complete (and push natively)
                 # after the full variant set is collected — resolve
                 # enough times for the TC wire to reach the C cache
-                for _ in range(8):
+                # one extra repeat: promotion to the C cache happens on
+                # the completed entry's first hit (r5)
+                for _ in range(9):
                     u = Message.decode(
                         await udp_ask_raw(server.udp_port, wire))
                     assert u.tc and not u.answers
