@@ -312,6 +312,140 @@ def _wait_for_file_line(path: str, pattern: bytes, what: str,
     raise RuntimeError("%s did not report its port within 30s" % what)
 
 
+N_TCP1 = int(os.environ.get("BENCH_TCP1_QUERIES", "5000"))
+N_TC_FLOWS = int(os.environ.get("BENCH_TC_FLOWS", "1500"))
+
+
+async def _tc_retry_flows(port: int, n_flows: int,
+                          conc: int = 16) -> Dict[str, float]:
+    """The tc=1 flow a no-EDNS UDP client actually runs: UDP query ->
+    truncated response -> RFC 1035 TCP retry -> full answer.  Driven
+    from Python (the flow is latency-bound, not packet-rate-bound);
+    each flow's latency covers both legs including the TCP connect."""
+    from binder_tpu.dns import Message as _M
+
+    loop = asyncio.get_running_loop()
+    pending: dict = {}
+
+    class _Udp(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            fut = pending.pop((data[0] << 8) | data[1], None)
+            if fut is not None and not fut.done():
+                fut.set_result(data)
+
+    transport, proto = await loop.create_datagram_endpoint(
+        _Udp, remote_addr=("127.0.0.1", port))
+    wire = bytearray(make_query("big.bench.com", Type.A,
+                                edns_payload=None).encode())
+    sem = asyncio.Semaphore(conc)
+    lats: List[float] = []
+    errors = 0
+
+    async def one(i: int) -> None:
+        nonlocal errors
+        async with sem:
+            t0 = time.perf_counter()
+            q = bytes((i >> 8, i & 0xFF)) + bytes(wire[2:])
+            fut = loop.create_future()
+            pending[i] = fut
+            proto.transport.sendto(q)
+            try:
+                resp = await asyncio.wait_for(fut, 5.0)
+            except asyncio.TimeoutError:
+                errors += 1
+                return
+            if not (resp[2] & 0x02):     # expected TC on the UDP leg
+                errors += 1
+                return
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            try:
+                writer.write(len(q).to_bytes(2, "big") + q)
+                await writer.drain()
+                hdr = await asyncio.wait_for(reader.readexactly(2), 5.0)
+                body = await asyncio.wait_for(
+                    reader.readexactly(int.from_bytes(hdr, "big")), 5.0)
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+            m = _M.decode(body)
+            if m.tc or not m.answers:
+                errors += 1
+                return
+            lats.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one(i) for i in range(n_flows)])
+    elapsed = time.perf_counter() - t0
+    transport.close()
+    lats.sort()
+    return {
+        "flows_per_s": n_flows / elapsed,
+        "p50_us": (lats[len(lats) // 2] * 1e6) if lats else None,
+        "p99_us": (lats[int(len(lats) * 0.99)] * 1e6) if lats else None,
+        "errors": errors,
+    }
+
+
+def _bench_tcp(tmpdir: str) -> Dict[str, float]:
+    """TCP serving axis (the reference serves TCP on the same port,
+    lib/server.js:643-653): persistent pipelined connections (tcp_qps),
+    one-connection-per-query (tcp1_qps, the non-keep-alive client
+    cost), and the tc=1 UDP->TCP retry flow for answers that truncate
+    at the classic 512-byte ceiling."""
+    fixture = os.path.join(tmpdir, "tcp_fixture.json")
+    fix = dict(FIXTURE)
+    # an answer set that must truncate for no-EDNS UDP clients
+    fix["/com/bench/big"] = {
+        "type": "service",
+        "service": {"srvce": "_big", "proto": "_tcp", "port": 80}}
+    for i in range(40):
+        fix[f"/com/bench/big/b{i:02d}"] = {
+            "type": "load_balancer",
+            "load_balancer": {"address": f"10.30.0.{i + 1}"}}
+    with open(fixture, "w") as f:
+        json.dump(fix, f)
+    config = os.path.join(tmpdir, "tcp_config.json")
+    with open(config, "w") as f:
+        json.dump({"dnsDomain": "bench.com", "datacenterName": "dc0",
+                   "host": "127.0.0.1",
+                   "store": {"backend": "fake", "fixture": fixture},
+                   "queryLog": False}, f)
+    proc = _launch_server(config)
+    try:
+        # wait for the TCP listener line directly (same port as UDP —
+        # the pair bind guarantees it); two sequential waits would race
+        # the pipe buffer (the first read may consume both lines)
+        port = _wait_for_line(
+            proc, rb"TCP DNS service started on [\d.]+:(\d+)\"",
+            "bench server tcp listener")
+        tmpl = os.path.join(tmpdir, "tcp_queries.bin")
+        _write_templates(tmpl, BENCH_MIX)
+        res = _median_passes(
+            lambda: _drive_native(port, tmpdir, tmpl_path=tmpl,
+                                  mode="tcp"), N_PASSES)
+        t1 = _drive_native(port, tmpdir, tmpl_path=tmpl, n=N_TCP1,
+                           mode="tcp1")
+        res["tcp1_qps"] = round(t1["qps"], 1)
+        res["tcp1_p99_us"] = round(t1["p99_us"], 1)
+        tc = asyncio.run(_tc_retry_flows(port, N_TC_FLOWS))
+        if tc["errors"] == 0:
+            res["tc_retry_flows_per_s"] = round(tc["flows_per_s"], 1)
+            res["tc_retry_p50_us"] = round(tc["p50_us"], 1)
+        else:
+            print(f"bench: tc-retry flow errors: {tc['errors']}",
+                  file=sys.stderr)
+        return res
+    finally:
+        _reap(proc)
+
+
 def _bench_logged(tmpdir: str) -> Dict[str, float]:
     """Hit-path throughput in the REFERENCE-PARITY posture: per-query
     logging ON (the reference logs every query unconditionally,
@@ -371,21 +505,25 @@ def _write_templates(path: str, mix, rd: bool = False) -> None:
 
 
 def _drive_native(port: int, tmpdir: str, tmpl_path: str = None,
-                  n: int = None) -> Dict[str, float]:
+                  n: int = None, mode: str = "udp",
+                  conns: int = 8) -> Dict[str, float]:
     """Drive load with the C++ generator (native/loadgen/dnsblast.cpp).
 
     On a single-core box the Python client's interpreter cost competes
     with the server for the same CPU; the native client keeps measurement
-    overhead negligible so the number reported is server capacity."""
+    overhead negligible so the number reported is server capacity.
+    Modes: udp (default), tcp (persistent pipelined connections), tcp1
+    (one connection per query)."""
     if tmpl_path is None:
         tmpl_path = os.path.join(tmpdir, "queries.bin")
         _write_templates(tmpl_path, BENCH_MIX)
     n = N_QUERIES if n is None else n
     assert n <= 65536, "dnsblast qid/state space"
+    extra = [] if mode == "udp" else ["-m", mode, "-T", str(conns)]
     out = subprocess.run(
         _pin("client")
         + [DNSBLAST, "-p", str(port), "-n", str(n),
-           "-w", str(CONCURRENCY), "-t", tmpl_path],
+           "-w", str(CONCURRENCY), "-t", tmpl_path] + extra,
         capture_output=True, text=True, timeout=330, check=True)
     return json.loads(out.stdout)
 
@@ -843,7 +981,7 @@ def _bench_topology(tmpdir: str, n_backends: int = 2,
 
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
-    topo = miss = churn = recur = fronted1 = logged = None
+    topo = miss = churn = recur = fronted1 = logged = tcp = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -863,6 +1001,11 @@ def run_bench() -> Dict[str, object]:
                 print(f"bench: logged axis failed: {e!r}",
                       file=sys.stderr)
                 logged = None
+            try:
+                tcp = _bench_tcp(tmpdir)
+            except Exception as e:
+                print(f"bench: tcp axis failed: {e!r}", file=sys.stderr)
+                tcp = None
             # miss/churn are primary axes: a failure must be loud on
             # stderr (stdout stays the single JSON line)
             try:
@@ -965,6 +1108,19 @@ def run_bench() -> Dict[str, object]:
         out["logged_p99_us"] = round(logged["p99_us"], 1)
         out["logged_vs_headline"] = round(logged["qps"] / res["qps"], 3)
         out["logged_log_lines"] = logged["log_lines"]
+    if tcp is not None:
+        # TCP serving (persistent pipelined conns / conn-per-query /
+        # the tc=1 UDP->TCP retry flow); attribution: the TCP lane is
+        # asyncio streams + the socket-free native serve entry, not the
+        # batched C drain, so a gap vs the UDP headline is expected
+        out["tcp_qps"] = round(tcp["qps"], 1)
+        out["tcp_qps_spread"] = tcp.get("qps_spread")
+        out["tcp_p50_us"] = round(tcp["p50_us"], 1)
+        out["tcp_p99_us"] = round(tcp["p99_us"], 1)
+        out["tcp1_qps"] = tcp.get("tcp1_qps")
+        out["tcp1_p99_us"] = tcp.get("tcp1_p99_us")
+        out["tc_retry_flows_per_s"] = tcp.get("tc_retry_flows_per_s")
+        out["tc_retry_p50_us"] = tcp.get("tc_retry_p50_us")
     if miss is not None:
         # cache-cold axis: every name queried exactly once (zone
         # precompile = the production cold path; engine_* = the Python

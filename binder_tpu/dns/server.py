@@ -590,33 +590,64 @@ class DnsServer:
             return
         self._conns.add(writer)
         self._tcp_conns.add(writer)
+
+        def send(wire: bytes) -> None:
+            # responses are produced asynchronously, so the
+            # write-buffer bound lives here: a client that asks
+            # but never reads must cost O(cap), not OOM
+            transport = writer.transport
+            if (transport.get_write_buffer_size()
+                    > self.max_tcp_write_buffer):
+                self.log.warning(
+                    "TCP client %s not reading responses "
+                    "(>%d bytes buffered), aborting", peer[0],
+                    self.max_tcp_write_buffer)
+                transport.abort()
+                return
+            writer.write(struct.pack(">H", len(wire)) + wire)
+
+        src = (peer[0], peer[1])
+        buf = b""
+        loop = asyncio.get_running_loop()
+        idle = self.tcp_idle_timeout
+        deadline = loop.time() + idle if idle else None
         try:
             while True:
-                # the idle clock covers the whole frame: a client
-                # trickling one byte per timeout ("slowloris") gets the
-                # same deadline as a silent one
-                async with asyncio.timeout(self.tcp_idle_timeout or None):
-                    hdr = await reader.readexactly(2)
-                    (length,) = struct.unpack(">H", hdr)
-                    data = await reader.readexactly(length)
-
-                def send(wire: bytes) -> None:
-                    # responses are produced asynchronously, so the
-                    # write-buffer bound lives here: a client that asks
-                    # but never reads must cost O(cap), not OOM
-                    transport = writer.transport
-                    if (transport.get_write_buffer_size()
-                            > self.max_tcp_write_buffer):
-                        self.log.warning(
-                            "TCP client %s not reading responses "
-                            "(>%d bytes buffered), aborting", peer[0],
-                            self.max_tcp_write_buffer)
-                        transport.abort()
+                # the idle deadline only advances when a COMPLETE frame
+                # is dispatched: a client trickling one byte per read
+                # ("slowloris") gets the same whole-frame deadline as a
+                # silent one
+                async with asyncio.timeout_at(deadline):
+                    chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                # bulk reframe: every complete frame in the chunk is
+                # dispatched in one pass (pipelining clients land many
+                # queries per read; two awaits per query would dominate
+                # the TCP serve path)
+                buf = buf + chunk if buf else chunk
+                off = 0
+                n = len(buf)
+                while n - off >= 2:
+                    length = (buf[off] << 8) | buf[off + 1]
+                    if length == 0:
+                        # a zero-length frame is never valid DNS (min
+                        # header is 12 bytes) and would count as free
+                        # deadline progress for a slot-squatting client:
+                        # drop the connection outright
+                        self.log.debug(
+                            "closing TCP connection from %s: zero-length"
+                            " frame", peer[0])
                         return
-                    writer.write(struct.pack(">H", len(wire)) + wire)
-
-                self._handle_raw(data, (peer[0], peer[1]), "tcp", send)
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+                    if n - off - 2 < length:
+                        break
+                    self._handle_raw(buf[off + 2:off + 2 + length], src,
+                                     "tcp", send)
+                    off += 2 + length
+                buf = buf[off:] if off else buf
+                if off and idle:
+                    deadline = loop.time() + idle
+        except ConnectionResetError:
             pass
         except TimeoutError:
             self.log.debug("closing idle TCP connection from %s", peer[0])

@@ -1,5 +1,5 @@
 /*
- * dnsblast — windowed UDP DNS load generator (dnsperf-equivalent).
+ * dnsblast — windowed DNS load generator (dnsperf-equivalent).
  *
  * The reference repo ships no load tool; its tests shell out to dig(1)
  * (reference test/dig.js:109-134), which cannot measure server capacity.
@@ -17,8 +17,18 @@
  *   - queries unanswered for RETRY_AFTER are retransmitted (loopback UDP
  *     drops under bursts); retransmitted ids are excluded from latency.
  *
+ * TCP modes (reference serves TCP on the same port,
+ * lib/server.js:643-653):
+ *   -m tcp    W queries in flight pipelined over -T persistent
+ *             connections (RFC 1035 2-byte framing), responses matched
+ *             by run-unique id;
+ *   -m tcp1   one CONNECTION PER QUERY, W concurrent: latency covers
+ *             connect + query + response + close — what a
+ *             non-keep-alive TCP client experiences.
+ *
  * Usage:
  *   dnsblast -p PORT [-H HOST] [-n QUERIES] [-w WINDOW] -t FILE
+ *            [-m udp|tcp|tcp1] [-T CONNS]
  * where FILE contains length-prefixed (u16 BE) DNS query wires to cycle.
  * Output: one JSON line {qps, elapsed_s, p50_us, p99_us, errors, retries}.
  */
@@ -29,7 +39,9 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fcntl.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
 #include <time.h>
@@ -84,27 +96,301 @@ std::vector<std::string> load_templates(const char *path) {
     return out;
 }
 
+void emit_result(long n_queries, double elapsed,
+                 std::vector<double> &latencies, long errors,
+                 long retries) {
+    std::sort(latencies.begin(), latencies.end());
+    double p50 = 0.0, p99 = 0.0;
+    if (!latencies.empty()) {
+        p50 = latencies[latencies.size() / 2] * 1e6;
+        p99 = latencies[(size_t)((double)latencies.size() * 0.99)] * 1e6;
+    }
+    printf("{\"qps\": %.1f, \"elapsed_s\": %.4f, \"p50_us\": %.1f, "
+           "\"p99_us\": %.1f, \"errors\": %ld, \"retries\": %ld}\n",
+           (double)n_queries / elapsed, elapsed, p50, p99, errors,
+           retries);
+}
+
+int make_tcp_conn(const struct sockaddr_in *sa) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) die("socket");
+    int one = 1;
+    (void)setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (fcntl(fd, F_SETFL, O_NONBLOCK) != 0) die("fcntl");
+    int rv = connect(fd, (const struct sockaddr *)sa, sizeof(*sa));
+    if (rv != 0 && errno != EINPROGRESS) die("connect");
+    return fd;
+}
+
+/* W queries pipelined over `nconns` persistent TCP connections. */
+int run_tcp(const struct sockaddr_in *sa,
+            const std::vector<std::string> &templates, long n_queries,
+            int window, int nconns) {
+    struct Conn {
+        int fd = -1;
+        std::string out;    /* unwritten framed queries */
+        size_t out_off = 0;
+        std::string in;     /* partial response frames */
+    };
+    if (nconns > window) nconns = window;
+    std::vector<Conn> conns((size_t)nconns);
+    for (auto &cn : conns) cn.fd = make_tcp_conn(sa);
+
+    std::vector<Outstanding> state(65536);
+    std::vector<double> latencies;
+    latencies.reserve((size_t)n_queries);
+    long next_idx = 0, received = 0, errors = 0;
+
+    auto enqueue = [&](long idx) {
+        const std::string &tmpl = templates[(size_t)idx % templates.size()];
+        Conn &cn = conns[(size_t)idx % conns.size()];
+        char hdr[2] = {(char)((tmpl.size() >> 8) & 0xff),
+                       (char)(tmpl.size() & 0xff)};
+        size_t base = cn.out.size();
+        cn.out.append(hdr, 2);
+        cn.out.append(tmpl);
+        cn.out[base + 2] = (char)((idx >> 8) & 0xff);
+        cn.out[base + 3] = (char)(idx & 0xff);
+        state[(size_t)idx].sent_at = now_s();
+        state[(size_t)idx].in_flight = true;
+    };
+
+    double t0 = now_s();
+    for (int i = 0; i < window && next_idx < n_queries; i++)
+        enqueue(next_idx++);
+
+    std::vector<struct pollfd> pfds((size_t)nconns);
+    char rbuf[65536];
+    while (received < n_queries) {
+        for (size_t i = 0; i < conns.size(); i++) {
+            pfds[i].fd = conns[i].fd;
+            pfds[i].events = POLLIN;
+            if (conns[i].out_off < conns[i].out.size())
+                pfds[i].events |= POLLOUT;
+            pfds[i].revents = 0;
+        }
+        int rv = poll(pfds.data(), (nfds_t)pfds.size(), 250);
+        if (now_s() - t0 > kRunTimeout) {
+            fprintf(stderr, "dnsblast: tcp run timed out (%ld/%ld)\n",
+                    received, n_queries);
+            return 1;
+        }
+        if (rv <= 0) continue;
+        for (size_t i = 0; i < conns.size(); i++) {
+            Conn &cn = conns[i];
+            if (pfds[i].revents & (POLLERR | POLLHUP)) {
+                fprintf(stderr, "dnsblast: tcp connection died\n");
+                return 1;
+            }
+            if ((pfds[i].revents & POLLOUT)
+                    && cn.out_off < cn.out.size()) {
+                ssize_t put = send(cn.fd, cn.out.data() + cn.out_off,
+                                   cn.out.size() - cn.out_off,
+                                   MSG_NOSIGNAL);
+                if (put > 0) {
+                    cn.out_off += (size_t)put;
+                    if (cn.out_off == cn.out.size()) {
+                        cn.out.clear();
+                        cn.out_off = 0;
+                    }
+                } else if (put < 0 && errno != EAGAIN
+                           && errno != EWOULDBLOCK && errno != EINTR) {
+                    die("tcp send");
+                }
+            }
+            if (pfds[i].revents & POLLIN) {
+                ssize_t got = recv(cn.fd, rbuf, sizeof(rbuf),
+                                   MSG_DONTWAIT);
+                if (got < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK
+                            || errno == EINTR)
+                        continue;
+                    die("tcp recv");
+                }
+                if (got == 0) {
+                    fprintf(stderr, "dnsblast: server closed tcp\n");
+                    return 1;
+                }
+                cn.in.append(rbuf, (size_t)got);
+                size_t off = 0;
+                while (cn.in.size() - off >= 2) {
+                    size_t flen =
+                        ((size_t)(unsigned char)cn.in[off] << 8)
+                        | (unsigned char)cn.in[off + 1];
+                    if (cn.in.size() - off - 2 < flen) break;
+                    const unsigned char *resp =
+                        (const unsigned char *)cn.in.data() + off + 2;
+                    if (flen >= 4) {
+                        unsigned qid = ((unsigned)resp[0] << 8) | resp[1];
+                        Outstanding &o = state[qid];
+                        if (o.in_flight) {
+                            o.in_flight = false;
+                            latencies.push_back(now_s() - o.sent_at);
+                            if (resp[3] & 0x0f) errors++;
+                            received++;
+                            if (next_idx < n_queries)
+                                enqueue(next_idx++);
+                        }
+                    }
+                    off += 2 + flen;
+                }
+                if (off > 0) cn.in.erase(0, off);
+            }
+        }
+    }
+    double elapsed = now_s() - t0;
+    for (auto &cn : conns) close(cn.fd);
+    std::vector<double> lats = std::move(latencies);
+    emit_result(n_queries, elapsed, lats, errors, 0);
+    return 0;
+}
+
+/* One connection per query, `window` concurrent: measures the full
+ * connect+query+response+close cycle. */
+int run_tcp1(const struct sockaddr_in *sa,
+             const std::vector<std::string> &templates, long n_queries,
+             int window) {
+    struct Slot {
+        int fd = -1;
+        long idx = -1;
+        double started = 0.0;
+        bool sent = false;
+        size_t out_off = 0;
+        std::string out;
+        std::string in;
+    };
+    if (window > 128) window = 128;   /* fd + accept-queue sanity */
+    std::vector<Slot> slots((size_t)window);
+    std::vector<double> latencies;
+    latencies.reserve((size_t)n_queries);
+    long next_idx = 0, received = 0, errors = 0;
+
+    auto open_slot = [&](Slot &s) {
+        if (next_idx >= n_queries) {
+            s.fd = -1;
+            return;
+        }
+        long idx = next_idx++;
+        const std::string &tmpl = templates[(size_t)idx % templates.size()];
+        s.fd = make_tcp_conn(sa);
+        s.idx = idx;
+        s.started = now_s();
+        s.sent = false;
+        s.out_off = 0;
+        s.out.clear();
+        char hdr[2] = {(char)((tmpl.size() >> 8) & 0xff),
+                       (char)(tmpl.size() & 0xff)};
+        s.out.append(hdr, 2);
+        s.out.append(tmpl);
+        s.out[2] = (char)((idx >> 8) & 0xff);
+        s.out[3] = (char)(idx & 0xff);
+        s.in.clear();
+    };
+
+    double t0 = now_s();
+    for (auto &s : slots) open_slot(s);
+
+    std::vector<struct pollfd> pfds((size_t)window);
+    char rbuf[65536];
+    while (received < n_queries) {
+        size_t nfds = 0;
+        for (auto &s : slots) {
+            if (s.fd < 0) continue;
+            pfds[nfds].fd = s.fd;
+            pfds[nfds].events = (short)(POLLIN
+                | (s.out_off < s.out.size() ? POLLOUT : 0));
+            pfds[nfds].revents = 0;
+            nfds++;
+        }
+        if (nfds == 0) break;
+        int rv = poll(pfds.data(), (nfds_t)nfds, 250);
+        if (now_s() - t0 > kRunTimeout) {
+            fprintf(stderr, "dnsblast: tcp1 run timed out (%ld/%ld)\n",
+                    received, n_queries);
+            return 1;
+        }
+        if (rv <= 0) continue;
+        size_t pi = 0;
+        for (auto &s : slots) {
+            if (s.fd < 0) continue;
+            struct pollfd &p = pfds[pi++];
+            if (p.revents & (POLLERR | POLLHUP)) {
+                fprintf(stderr, "dnsblast: tcp1 connection died\n");
+                return 1;
+            }
+            if ((p.revents & POLLOUT) && s.out_off < s.out.size()) {
+                ssize_t put = send(s.fd, s.out.data() + s.out_off,
+                                   s.out.size() - s.out_off,
+                                   MSG_NOSIGNAL);
+                if (put > 0) s.out_off += (size_t)put;
+                else if (put < 0 && errno != EAGAIN
+                         && errno != EWOULDBLOCK && errno != EINTR)
+                    die("tcp1 send");
+            }
+            if (p.revents & POLLIN) {
+                ssize_t got = recv(s.fd, rbuf, sizeof(rbuf),
+                                   MSG_DONTWAIT);
+                if (got == 0) {
+                    /* peer EOF before a full response (cap refusal,
+                     * abort): count it and recycle the slot — spinning
+                     * on a readable-EOF fd would burn the run timeout */
+                    errors++;
+                    close(s.fd);
+                    received++;
+                    open_slot(s);
+                    continue;
+                }
+                if (got > 0) s.in.append(rbuf, (size_t)got);
+                if (s.in.size() >= 2) {
+                    size_t flen =
+                        ((size_t)(unsigned char)s.in[0] << 8)
+                        | (unsigned char)s.in[1];
+                    if (s.in.size() >= 2 + flen) {
+                        const unsigned char *resp =
+                            (const unsigned char *)s.in.data() + 2;
+                        if (flen >= 4 && (resp[3] & 0x0f)) errors++;
+                        latencies.push_back(now_s() - s.started);
+                        received++;
+                        close(s.fd);
+                        open_slot(s);
+                    }
+                }
+            }
+        }
+    }
+    double elapsed = now_s() - t0;
+    for (auto &s : slots)
+        if (s.fd >= 0) close(s.fd);
+    emit_result(n_queries, elapsed, latencies, errors, 0);
+    return 0;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
     const char *host = "127.0.0.1";
     const char *tmpl_path = nullptr;
+    const char *mode = "udp";
     int port = 0;
     long n_queries = 50000;
     int window = 64;
+    int nconns = 8;
 
     int c;
-    while ((c = getopt(argc, argv, "H:p:n:w:t:")) != -1) {
+    while ((c = getopt(argc, argv, "H:p:n:w:t:m:T:")) != -1) {
         switch (c) {
         case 'H': host = optarg; break;
         case 'p': port = atoi(optarg); break;
         case 'n': n_queries = atol(optarg); break;
         case 'w': window = atoi(optarg); break;
         case 't': tmpl_path = optarg; break;
+        case 'm': mode = optarg; break;
+        case 'T': nconns = atoi(optarg); break;
         default:
             fprintf(stderr,
                     "usage: dnsblast -p port [-H host] [-n queries] "
-                    "[-w window] -t templates\n");
+                    "[-w window] [-m udp|tcp|tcp1] [-T conns] "
+                    "-t templates\n");
             return 2;
         }
     }
@@ -119,11 +405,10 @@ int main(int argc, char **argv) {
     }
     if (window < 1) window = 1;
     if ((long)window > n_queries) window = (int)n_queries;
+    if (nconns < 1) nconns = 1;
 
     std::vector<std::string> templates = load_templates(tmpl_path);
 
-    int fd = socket(AF_INET, SOCK_DGRAM, 0);
-    if (fd < 0) die("socket");
     struct sockaddr_in sa;
     memset(&sa, 0, sizeof(sa));
     sa.sin_family = AF_INET;
@@ -132,6 +417,17 @@ int main(int argc, char **argv) {
         fprintf(stderr, "dnsblast: bad host %s\n", host);
         return 2;
     }
+    if (strcmp(mode, "tcp") == 0)
+        return run_tcp(&sa, templates, n_queries, window, nconns);
+    if (strcmp(mode, "tcp1") == 0)
+        return run_tcp1(&sa, templates, n_queries, window);
+    if (strcmp(mode, "udp") != 0) {
+        fprintf(stderr, "dnsblast: unknown mode %s\n", mode);
+        return 2;
+    }
+
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) die("socket");
     if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) die("connect");
     int rcvbuf = 1 << 20;
     (void)setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof(rcvbuf));

@@ -55,8 +55,10 @@ async def start_balancer(sockdir, scan_ms=150, cache_ms=60000,
         "-s", str(scan_ms), "-c", str(cache_ms),
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.DEVNULL)
-    line = await asyncio.wait_for(proc.stdout.readline(), 5)
-    assert line.startswith(b"PORT ")
+    # generous deadline: on a loaded single-core box (bench processes,
+    # parallel suites) 5s was observed to flake the whole-suite gate
+    line = await asyncio.wait_for(proc.stdout.readline(), 30)
+    assert line.startswith(b"PORT "), line
     return proc, int(line.split()[1])
 
 

@@ -630,3 +630,74 @@ class TestPairBind:
                     await s.stop()
 
         asyncio.run(run())
+
+
+class TestTcpFrameDeadline:
+    def test_byte_trickler_disconnected(self):
+        """Slowloris: steady 1-byte-per-interval traffic must NOT reset
+        the idle deadline — only a complete frame does (r5 regression
+        guard for the bulk-reframe read loop)."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, tcp_idle_timeout=0.6)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.tcp_port)
+            try:
+                t0 = asyncio.get_running_loop().time()
+                closed_at = None
+                # header promising a 100-byte frame, then 1 byte per
+                # interval: bytes keep flowing, the frame never completes
+                writer.write(b"\x00\x64")
+                for _ in range(20):
+                    writer.write(b"\x01")
+                    await writer.drain()
+                    try:
+                        got = await asyncio.wait_for(reader.read(16), 0.25)
+                    except TimeoutError:
+                        continue
+                    except (ConnectionResetError, BrokenPipeError):
+                        closed_at = asyncio.get_running_loop().time()
+                        break
+                    if got == b"":
+                        closed_at = asyncio.get_running_loop().time()
+                        break
+                assert closed_at is not None, "trickler never disconnected"
+                assert closed_at - t0 < 3.0
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+                await server.stop()
+
+        asyncio.run(run())
+
+    def test_steady_frames_stay_connected(self):
+        """Complete frames slower than the byte-level interval but
+        faster than the idle deadline keep the connection alive."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache, tcp_idle_timeout=0.6)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.tcp_port)
+            try:
+                for qid in range(5):
+                    wire = make_query("web.foo.com", Type.A,
+                                      qid=qid).encode()
+                    writer.write(struct.pack(">H", len(wire)) + wire)
+                    await writer.drain()
+                    (ln,) = struct.unpack(
+                        ">H", await reader.readexactly(2))
+                    m = Message.decode(await reader.readexactly(ln))
+                    assert m.rcode == Rcode.NOERROR
+                    await asyncio.sleep(0.4)   # < deadline per frame
+            finally:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+                await server.stop()
+
+        asyncio.run(run())
