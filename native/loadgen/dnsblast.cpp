@@ -240,8 +240,7 @@ int run_tcp(const struct sockaddr_in *sa,
     }
     double elapsed = now_s() - t0;
     for (auto &cn : conns) close(cn.fd);
-    std::vector<double> lats = std::move(latencies);
-    emit_result(n_queries, elapsed, lats, errors, 0);
+    emit_result(n_queries, elapsed, latencies, errors, 0);
     return 0;
 }
 
@@ -399,7 +398,8 @@ int main(int argc, char **argv) {
         return 2;
     }
     if (n_queries < 1 || n_queries > 65536) {
-        /* ids must stay unique across the run for unambiguous matching */
+        /* ids must stay unique across the run for unambiguous matching;
+         * all three modes index 65536-slot state tables by query idx */
         fprintf(stderr, "dnsblast: -n must be in [1, 65536]\n");
         return 2;
     }
@@ -500,15 +500,6 @@ int main(int argc, char **argv) {
     }
     double elapsed = now_s() - t0;
     close(fd);
-
-    std::sort(latencies.begin(), latencies.end());
-    double p50 = 0.0, p99 = 0.0;
-    if (!latencies.empty()) {
-        p50 = latencies[latencies.size() / 2] * 1e6;
-        p99 = latencies[(size_t)((double)latencies.size() * 0.99)] * 1e6;
-    }
-    printf("{\"qps\": %.1f, \"elapsed_s\": %.4f, \"p50_us\": %.1f, "
-           "\"p99_us\": %.1f, \"errors\": %ld, \"retries\": %ld}\n",
-           (double)n_queries / elapsed, elapsed, p50, p99, errors, retries);
+    emit_result(n_queries, elapsed, latencies, errors, retries);
     return 0;
 }

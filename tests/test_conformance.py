@@ -27,6 +27,7 @@ import ipaddress
 import os
 import shutil
 import subprocess
+import sys
 
 import pytest
 
@@ -296,34 +297,46 @@ LIBC_GATE = os.environ.get("BINDER_LIBC_CONFORMANCE") == "1" \
     and os.geteuid() == 0
 
 
+class resolv_override:
+    """Crash-safe /etc/resolv.conf override for the libc-backed tiers:
+    if a previous run was SIGKILLed between the rewrite and the
+    restore, the ``.binder-backup`` beside it holds the true original
+    and is the source of truth, never re-snapshotted over."""
+
+    RESOLV = "/etc/resolv.conf"
+    BACKUP = RESOLV + ".binder-backup"
+
+    def __init__(self, content: str) -> None:
+        self.content = content
+        self.saved = None
+
+    def __enter__(self) -> "resolv_override":
+        if os.path.exists(self.BACKUP):
+            self.saved = open(self.BACKUP).read()
+            with open(self.RESOLV, "w") as f:
+                f.write(self.saved)
+        else:
+            self.saved = open(self.RESOLV).read()
+            with open(self.BACKUP, "w") as f:
+                f.write(self.saved)
+        with open(self.RESOLV, "w") as f:
+            f.write(self.content)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with open(self.RESOLV, "w") as f:
+            f.write(self.saved)
+        os.unlink(self.BACKUP)
+
+
 @pytest.mark.skipif(
     not LIBC_GATE,
     reason="set BINDER_LIBC_CONFORMANCE=1 (requires root; rewrites "
            "/etc/resolv.conf and binds 127.0.0.1:53)")
 class TestLibcConformance:
     def test_getent_a_and_ptr(self):
-        resolv = "/etc/resolv.conf"
-        backup = resolv + ".binder-backup"
-        # crash-safe: if this process is SIGKILLed between the rewrite
-        # and the finally-restore, the original survives on disk beside
-        # the clobbered file.  A backup already present means exactly
-        # that happened on a previous run — it holds the true original,
-        # and resolv.conf holds our leftover rewrite, so the backup is
-        # the source of truth, never re-snapshotted over.
-        if os.path.exists(backup):
-            saved = open(backup).read()
-            with open(resolv, "w") as f:
-                f.write(saved)
-        else:
-            saved = open(resolv).read()
-            with open(backup, "w") as f:
-                f.write(saved)
-
         async def run(server):
             loop = asyncio.get_running_loop()
-            with open(resolv, "w") as f:
-                f.write("nameserver 127.0.0.1\noptions timeout:2 "
-                        "attempts:1\n")
 
             def getent(*args):
                 return subprocess.run(["getent", *args],
@@ -340,15 +353,78 @@ class TestLibcConformance:
             assert "web.foo.com" in out.stdout, out
 
         try:
-            asyncio.run(serve(run, port=53))
+            with resolv_override("nameserver 127.0.0.1\n"
+                                 "options timeout:2 attempts:1\n"):
+                asyncio.run(serve(run, port=53))
         except OSError as e:
             if e.errno == errno.EADDRINUSE:
                 pytest.skip("127.0.0.1:53 already bound on this host")
             raise
-        finally:
-            with open(resolv, "w") as f:
-                f.write(saved)
-            os.unlink(backup)
+
+
+@pytest.mark.skipif(
+    not LIBC_GATE,
+    reason="set BINDER_LIBC_CONFORMANCE=1 (requires root; rewrites "
+           "/etc/resolv.conf and binds 127.0.0.1:53)")
+class TestLibresolvConformance:
+    """glibc's res_query + ns_parserr (tools/libresolv_probe.py) as the
+    independent client for the record types getent cannot reach: SRV
+    answer content (target/port/priority), SRV additionals, and the
+    EDNS OPT echo — the coverage the reference got from dig
+    (reference test/dig.js:109-134, test/service.test.js:162-177)."""
+
+    @staticmethod
+    def _probe(name, qtype):
+        out = subprocess.run(
+            [sys.executable, os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "tools", "libresolv_probe.py"), name, qtype],
+            capture_output=True, text=True, timeout=20)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        import json as _json
+        return _json.loads(out.stdout)
+
+    def test_srv_a_ptr_and_edns_echo(self):
+        async def run(server):
+            loop = asyncio.get_running_loop()
+            probe = self._probe
+
+            # SRV: answer content parsed by glibc, not our codec
+            r = await loop.run_in_executor(
+                None, probe, "_pg._tcp.svc.foo.com", "SRV")
+            assert r["ancount"] == 1, r
+            srv = r["answers"][0]
+            assert srv["type"] == 33
+            assert srv["port"] == 5432
+            assert srv["priority"] == 0
+            assert srv["target"] == "lb0.svc.foo.com"
+            # the SRV additional carries the target's A record
+            adds = [a for a in r["additional"] if a["type"] == 1]
+            assert adds and adds[0]["name"] == "lb0.svc.foo.com"
+            assert adds[0]["address"] == "10.0.1.1"
+            # glibc sent EDNS (options edns0): the OPT must be echoed
+            # with our payload ceiling
+            assert r["opt"] == {"payload": 1232}, r
+
+            # A and PTR through the same independent parser
+            r = await loop.run_in_executor(None, probe,
+                                           "web.foo.com", "A")
+            assert [a["address"] for a in r["answers"]] == ["10.7.7.7"]
+            assert r["answers"][0]["ttl"] == 30
+            assert r["opt"] == {"payload": 1232}
+            r = await loop.run_in_executor(
+                None, probe, "7.7.7.10.in-addr.arpa", "PTR")
+            assert [a["target"] for a in r["answers"]] == ["web.foo.com"]
+
+        try:
+            with resolv_override("nameserver 127.0.0.1\n"
+                                 "options timeout:2 attempts:1 edns0\n"):
+                asyncio.run(serve(run, port=53))
+        except OSError as e:
+            if e.errno == errno.EADDRINUSE:
+                pytest.skip("127.0.0.1:53 already bound on this host")
+            raise
 
 
 # ---------------------------------------------------------------------------

@@ -29,11 +29,13 @@ TIERS = [
     ("rfc-golden-vectors", "tests.test_conformance", "TestGoldenVectors"),
     ("dig(1)", "tests.test_conformance", "TestDigConformance"),
     ("glibc-getent", "tests.test_conformance", "TestLibcConformance"),
+    ("glibc-libresolv", "tests.test_conformance",
+     "TestLibresolvConformance"),
     ("real-zookeeper", "tests.test_conformance", "TestRealZooKeeper"),
     ("real-systemd", "tests.test_systemd_real_conformance",
      "TestRealSystemd"),
 ]
-DNS_CLIENT_TIERS = {"dig(1)", "glibc-getent"}
+DNS_CLIENT_TIERS = {"dig(1)", "glibc-getent", "glibc-libresolv"}
 MODULES = {m for _, m, _ in TIERS}
 
 

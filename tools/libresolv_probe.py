@@ -1,0 +1,134 @@
+"""Query DNS through glibc's resolver (libresolv) and print parsed JSON.
+
+An INDEPENDENT DNS implementation for conformance testing: glibc's
+``res_query`` builds and sends the query and ``ns_initparse``/
+``ns_parserr`` parse the response — none of this repo's codec is
+involved on the client side (the coverage the reference got from
+shelling out to dig(1), reference test/dig.js:109-134).  Uses
+/etc/resolv.conf for the server address like any stub-resolver client;
+run it with the conformance tier's resolv.conf override in place.
+
+Usage: python3 tools/libresolv_probe.py NAME QTYPE
+  QTYPE: A | SRV | PTR
+Output: one JSON object:
+  {"rcode_ok": true, "ancount": N,
+   "answers": [{"name": ..., "type": N, "ttl": N, ...type fields}],
+   "additional": [...same...], "opt": {"payload": N} | null}
+
+Exit 0 on a parsed NOERROR response; 1 on lookup/parse failure (the
+h_errno detail goes to stderr).
+"""
+import ctypes
+import json
+import socket
+import sys
+
+NS_MAXDNAME = 1025
+C_IN = 1
+QTYPES = {"A": 1, "PTR": 12, "SRV": 33}
+NS_S_AN = 1     # answer section (arpa/nameser.h ns_sect)
+NS_S_AR = 3     # additional section
+
+
+class NsMsg(ctypes.Structure):
+    # glibc arpa/nameser.h struct __ns_msg (layout stable since glibc 2.x)
+    _fields_ = [
+        ("_msg", ctypes.c_void_p),
+        ("_eom", ctypes.c_void_p),
+        ("_id", ctypes.c_uint16),
+        ("_flags", ctypes.c_uint16),
+        ("_counts", ctypes.c_uint16 * 4),
+        ("_sections", ctypes.c_void_p * 4),
+        ("_sect", ctypes.c_int),
+        ("_rrnum", ctypes.c_int),
+        ("_msg_ptr", ctypes.c_void_p),
+    ]
+
+
+class NsRr(ctypes.Structure):
+    # glibc arpa/nameser.h struct __ns_rr
+    _fields_ = [
+        ("name", ctypes.c_char * NS_MAXDNAME),
+        ("rtype", ctypes.c_uint16),
+        ("rr_class", ctypes.c_uint16),
+        ("ttl", ctypes.c_uint32),
+        ("rdlength", ctypes.c_uint16),
+        ("rdata", ctypes.c_void_p),
+    ]
+
+
+def main() -> int:
+    name, qtype_name = sys.argv[1], sys.argv[2]
+    qtype = QTYPES[qtype_name]
+
+    res = ctypes.CDLL("libresolv.so.2", use_errno=True)
+    res.res_query.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                              ctypes.c_char_p, ctypes.c_int]
+    res.ns_initparse.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.POINTER(NsMsg)]
+    res.ns_parserr.argtypes = [ctypes.POINTER(NsMsg), ctypes.c_int,
+                               ctypes.c_int, ctypes.POINTER(NsRr)]
+    res.ns_name_uncompress.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_size_t]
+
+    buf = ctypes.create_string_buffer(4096)
+    n = res.res_query(name.encode(), C_IN, qtype, buf, len(buf))
+    if n < 0:
+        print("res_query failed (h_errno path)", file=sys.stderr)
+        return 1
+
+    msg = NsMsg()
+    if res.ns_initparse(buf, n, ctypes.byref(msg)) != 0:
+        print("ns_initparse failed", file=sys.stderr)
+        return 1
+
+    def uncompress(ptr: int) -> str:
+        out = ctypes.create_string_buffer(NS_MAXDNAME)
+        got = res.ns_name_uncompress(msg._msg, msg._eom, ptr, out,
+                                     NS_MAXDNAME)
+        if got < 0:
+            raise ValueError("ns_name_uncompress failed")
+        return out.value.decode()
+
+    def parse_section(sect: int, count: int):
+        records = []
+        opt = None
+        for i in range(count):
+            rr = NsRr()
+            if res.ns_parserr(ctypes.byref(msg), sect, i,
+                              ctypes.byref(rr)) != 0:
+                raise ValueError(f"ns_parserr failed ({sect},{i})")
+            rd = ctypes.string_at(rr.rdata, rr.rdlength) \
+                if rr.rdlength else b""
+            rec = {"name": rr.name.decode(), "type": rr.rtype,
+                   "ttl": rr.ttl}
+            if rr.rtype == 41:          # OPT: class carries the payload
+                opt = {"payload": rr.rr_class}
+                continue
+            if rr.rtype == 1 and len(rd) == 4:
+                rec["address"] = socket.inet_ntoa(rd)
+            elif rr.rtype == 33 and len(rd) >= 6:
+                rec["priority"] = (rd[0] << 8) | rd[1]
+                rec["weight"] = (rd[2] << 8) | rd[3]
+                rec["port"] = (rd[4] << 8) | rd[5]
+                rec["target"] = uncompress(rr.rdata + 6)
+            elif rr.rtype == 12:
+                rec["target"] = uncompress(rr.rdata)
+            records.append(rec)
+        return records, opt
+
+    answers, _ = parse_section(NS_S_AN, msg._counts[NS_S_AN])
+    additional, opt = parse_section(NS_S_AR, msg._counts[NS_S_AR])
+    print(json.dumps({
+        "rcode_ok": True,               # res_query returns <0 otherwise
+        "ancount": msg._counts[NS_S_AN],
+        "answers": answers,
+        "additional": additional,
+        "opt": opt,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
