@@ -58,6 +58,8 @@ ci:
 bench-smoke: native
 	@mkdir -p .scratch
 	BENCH_QUERIES=5000 BENCH_PASSES=1 BENCH_MISS_QUERIES=2000 \
+		BENCH_RECURSION_QUERIES=2000 BENCH_TCP1_QUERIES=1500 \
+		BENCH_TC_FLOWS=300 \
 		BENCH_BASELINE_FILE=.scratch/bench_smoke_baseline.json \
 		$(PY) bench.py
 
