@@ -353,7 +353,8 @@ class DnsServer:
     # starvation of timers/TCP under sustained UDP flood.
     _UDP_BURST = 128
 
-    async def listen_udp(self, address: str, port: int) -> int:
+    async def listen_udp(self, address: str, port: int,
+                         announce: bool = True) -> int:
         """Direct add_reader recv/send loop.
 
         asyncio's DatagramTransport costs ~15µs/packet in protocol
@@ -361,7 +362,12 @@ class DnsServer:
         DNS responder doesn't need; reading the socket ourselves roughly
         doubles single-process throughput.  Send errors are tolerated
         best-effort like the reference (EHOSTUNREACH etc.,
-        lib/server.js:593-607) — UDP clients retry."""
+        lib/server.js:593-607) — UDP clients retry.
+
+        ``announce=False`` defers the "service started" log line — the
+        ephemeral pair bind (BinderServer.start) must not advertise a
+        port it may yet release and redraw: harnesses watch that line,
+        and one observed CI failure latched a redrawn (dead) port."""
         loop = asyncio.get_running_loop()
         fam = socket.AF_INET6 if ":" in address else socket.AF_INET
         sock = socket.socket(fam, socket.SOCK_DGRAM)
@@ -411,8 +417,15 @@ class DnsServer:
         loop.add_reader(sock.fileno(), on_readable)
         self._udp_socks.append((loop, sock))
         actual = sock.getsockname()[1]
-        self.log.info("UDP DNS service started on %s:%d", address, actual)
+        if announce:
+            self.announce_udp(address, actual)
         return actual
+
+    def announce_udp(self, address: str, port: int) -> None:
+        self.log.info("UDP DNS service started on %s:%d", address, port)
+
+    def announce_tcp(self, address: str, port: int) -> None:
+        self.log.info("TCP DNS service started on %s:%d", address, port)
 
     def close_udp_listener(self, port: int) -> None:
         """Tear down one bound UDP listener.  Used by the paired-bind
@@ -557,11 +570,13 @@ class DnsServer:
 
     # -- TCP (2-byte length framing, RFC 1035 §4.2.2) --
 
-    async def listen_tcp(self, address: str, port: int) -> int:
+    async def listen_tcp(self, address: str, port: int,
+                         announce: bool = True) -> int:
         server = await asyncio.start_server(self._tcp_conn, address, port)
         self._tcp_servers.append(server)
         actual = server.sockets[0].getsockname()[1]
-        self.log.info("TCP DNS service started on %s:%d", address, actual)
+        if announce:
+            self.announce_tcp(address, actual)
         return actual
 
     async def _tcp_conn(self, reader: asyncio.StreamReader,

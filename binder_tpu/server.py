@@ -1497,10 +1497,23 @@ class BinderServer:
         # loop: release the UDP draw and redraw instead of failing
         # (the observed CI flake: EADDRINUSE on the UDP-chosen port).
         for attempt in range(self._PAIR_BIND_ATTEMPTS):
-            udp_port = await self.engine.listen_udp(self.host, self.port)
+            # announce only once the PAIR is secured: harnesses watch
+            # the "service started" lines for the port, and a line
+            # printed for a draw that is then released and redrawn
+            # advertises a dead port (observed as a CI dnsblast
+            # connection-refused failure)
+            try:
+                udp_port = await self.engine.listen_udp(
+                    self.host, self.port, announce=False)
+            except OSError:
+                # a UDP bind failure (fixed port taken) must release
+                # the balancer listener opened above, like the TCP path
+                await self.engine.close()
+                raise
             try:
                 self.tcp_port = await self.engine.listen_tcp(
-                    self.host, self.port if self.port else udp_port)
+                    self.host, self.port if self.port else udp_port,
+                    announce=False)
             except OSError as e:
                 # the failed draw must be released even when re-raising:
                 # callers treat start() as atomic and won't stop() a
@@ -1518,6 +1531,8 @@ class BinderServer:
                 await self.engine.close()
                 raise
             self.udp_port = udp_port
+            self.engine.announce_udp(self.host, udp_port)
+            self.engine.announce_tcp(self.host, self.tcp_port)
             break
         if self._log_ring and self._log_flush_task is None:
             # periodic drain for the lanes without a C drain loop of

@@ -559,13 +559,15 @@ class TestPairBind:
             real_listen_udp = server.engine.listen_udp
             calls = []
 
-            async def forced_listen_udp(host, port):
+            async def forced_listen_udp(host, port, announce=True):
                 # first draw lands on the TCP-occupied port (what the
                 # kernel did to CI); later draws are honest
                 calls.append(port)
                 if len(calls) == 1:
-                    return await real_listen_udp(host, taken)
-                return await real_listen_udp(host, port)
+                    return await real_listen_udp(host, taken,
+                                                 announce=announce)
+                return await real_listen_udp(host, port,
+                                             announce=announce)
 
             server.engine.listen_udp = forced_listen_udp
             await server.start()
@@ -601,6 +603,33 @@ class TestPairBind:
                 await server.start()
             except OSError:
                 assert server.engine._udp_socks == []
+                return True
+            finally:
+                blocker.close()
+                await server.stop()
+            return False
+
+        assert asyncio.run(run())
+
+    def test_fixed_udp_port_taken_releases_balancer(self, tmp_path):
+        """A fixed UDP port already bound: start() must raise AND
+        release the balancer listener opened before the pair bind."""
+        async def run():
+            store, cache = fixture_store()
+            blocker = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            blocker.bind(("127.0.0.1", 0))
+            taken = blocker.getsockname()[1]
+            sock_path = str(tmp_path / "b.sock")
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="coal",
+                                  host="127.0.0.1", port=taken,
+                                  balancer_socket=sock_path,
+                                  collector=MetricsCollector())
+            try:
+                await server.start()
+            except OSError:
+                assert server.engine._udp_socks == []
+                assert server.engine._unix_servers == []
                 return True
             finally:
                 blocker.close()
@@ -700,4 +729,54 @@ class TestTcpFrameDeadline:
                     pass
                 await server.stop()
 
+        asyncio.run(run())
+
+
+class TestPairBindAnnouncement:
+    def test_redraw_announces_only_final_port(self, caplog):
+        """The 'service started' lines are the port-discovery contract
+        for harnesses (bench/systemd logs): a redrawn (released) draw
+        must never be announced — only the secured pair, exactly once
+        (the r5 CI failure: dnsblast latched a dead first-draw port)."""
+        async def run():
+            store, cache = fixture_store()
+            blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            blocker.bind(("127.0.0.1", 0))
+            blocker.listen(1)
+            taken = blocker.getsockname()[1]
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="coal",
+                                  host="127.0.0.1", port=0,
+                                  collector=MetricsCollector())
+            real_listen_udp = server.engine.listen_udp
+            first = []
+
+            async def forced(host, port, announce=True):
+                if not first:
+                    first.append(True)
+                    return await real_listen_udp(host, taken,
+                                                 announce=announce)
+                return await real_listen_udp(host, port,
+                                             announce=announce)
+
+            server.engine.listen_udp = forced
+            await server.start()
+            try:
+                udp_lines = [r.getMessage() for r in caplog.records
+                             if "UDP DNS service started" in r.getMessage()]
+                tcp_lines = [r.getMessage() for r in caplog.records
+                             if "TCP DNS service started" in r.getMessage()]
+                assert udp_lines == \
+                    [f"UDP DNS service started on 127.0.0.1:"
+                     f"{server.udp_port}"]
+                assert tcp_lines == \
+                    [f"TCP DNS service started on 127.0.0.1:"
+                     f"{server.tcp_port}"]
+                assert server.udp_port != taken
+            finally:
+                blocker.close()
+                await server.stop()
+
+        import logging as _logging
+        caplog.set_level(_logging.INFO, logger="binder.server")
         asyncio.run(run())
