@@ -99,6 +99,11 @@ void fuzz_one(const uint8_t *data, size_t len) {
         fz_c->lr.len = 0;               /* drained by Python */
 
     uint8_t out[FP_MAX_WIRE];
+    /* zero-length inputs arrive with data == nullptr: every direct
+     * data[0] read below must go through this guarded copy (a seed-6
+     * coverage soak minted an empty corpus entry and UBSan flagged the
+     * null load) */
+    const uint8_t d0 = len > 0 ? data[0] : 0;
 
     if (fz_iter % 3 == 0) {
         /* raw client bytes straight into the serve path (cache AND
@@ -133,7 +138,7 @@ void fuzz_one(const uint8_t *data, size_t len) {
                              ? data[7 + i] * 9u : 16u);
             if (bl > FP_MAX_WIRE) bl = FP_MAX_WIRE;
             for (size_t b = 0; b < bl; b++)
-                body_store[i][b] = (uint8_t)(b * 17 + data[0] + i);
+                body_store[i][b] = (uint8_t)(b * 17 + d0 + i);
             bodies[i] = body_store[i];
             blens[i] = (uint16_t)bl;
         }
@@ -231,14 +236,14 @@ void fuzz_one(const uint8_t *data, size_t len) {
                 ? data[6 + i] * 7u : 0;
             size_t wl = base + extra;
             if (wl > FP_MAX_WIRE) wl = FP_MAX_WIRE;
-            if (i > 0 && (data[0] + i) % 5 == 0)
-                wl = 12 + (size_t)(data[0] % (qn_len + 4));  /* short */
+            if (i > 0 && (d0 + i) % 5 == 0)
+                wl = 12 + (size_t)(d0 % (qn_len + 4));  /* short */
             memcpy(w, q, 12);
             w[2] |= 0x80;               /* QR */
             if (wl >= base)
                 memcpy(w + 12, q + 12, qn_len + 4);
             for (size_t b = (wl >= base ? base : 12); b < wl; b++)
-                w[b] = (uint8_t)(b * 31 + data[0]);
+                w[b] = (uint8_t)(b * 31 + d0);
             wires[i] = w;
             lens[i] = (uint16_t)wl;
         }
