@@ -1402,13 +1402,33 @@ class BinderServer:
             return
         if not block:
             return
-        text = block.decode("utf-8", "replace")
+        text = None
         for h in self._log_json_handlers:
             try:
                 h.acquire()
                 try:
-                    h.stream.write(text)
-                    h.flush()
+                    buf = getattr(h.stream, "buffer", None)
+                    # bytes straight through ONLY when the text layer
+                    # would have produced the same bytes: UTF-8-family
+                    # encoding and no newline translation — otherwise
+                    # ring lines and formatter lines would mix
+                    # encodings/line-endings in one file
+                    enc = (getattr(h.stream, "encoding", "") or "") \
+                        .lower().replace("-", "")
+                    nl = getattr(h.stream, "newlines", None)
+                    if (buf is not None
+                            and enc in ("utf8", "ascii", "usascii")
+                            and nl in (None, "\n")):
+                        # (flush the text layer first so lines the
+                        # Python formatter wrote stay ordered)
+                        h.stream.flush()
+                        buf.write(block)
+                        buf.flush()
+                    else:
+                        if text is None:
+                            text = block.decode("utf-8", "replace")
+                        h.stream.write(text)
+                        h.flush()
                 finally:
                     h.release()
             except Exception:
