@@ -700,8 +700,10 @@ async def _bench_churn_async(tmpdir: str) -> Dict[str, float]:
                             "host": {"address": f"10.9.0.{i + 1}"}}
                            ).encode())
 
-        churn_sockdir = os.path.join(tmpdir, "churn_sock")
-        os.mkdir(churn_sockdir)
+        # unique per attempt: the axis retry (_try_axis) must not die
+        # on a directory a failed first attempt left behind
+        churn_sockdir = tempfile.mkdtemp(dir=tmpdir,
+                                         prefix="churn_sock")
         config = os.path.join(tmpdir, "churn_config.json")
         with open(config, "w") as f:
             json.dump({"dnsDomain": "bench.com", "datacenterName": "dc0",
@@ -933,8 +935,9 @@ def _bench_topology(tmpdir: str, n_backends: int = 2,
     balancer's per-stage counters (cache hit rate, forward RTT, write
     queue high-water) ride along so a cross-round delta on this axis
     can be attributed to a stage instead of bisected blind."""
-    sockdir = os.path.join(tmpdir, f"vsock{tag}")
-    os.mkdir(sockdir)
+    # unique per attempt: the axis retry (_try_axis) must not die on a
+    # directory a failed first attempt left behind
+    sockdir = tempfile.mkdtemp(dir=tmpdir, prefix=f"vsock{tag}")
     fixture = os.path.join(tmpdir, "fixture.json")
     if not os.path.exists(fixture):
         with open(fixture, "w") as f:
@@ -979,6 +982,21 @@ def _bench_topology(tmpdir: str, n_backends: int = 2,
             _reap(p)
 
 
+def _try_axis(name: str, fn, retries: int = 1):
+    """Run one bench axis, retrying once on failure: every axis is
+    exception-guarded so a transient (a busy box stretching a startup
+    deadline) must cost a retry, not the round's only recorded figures.
+    Failures are loud on stderr; stdout stays the single JSON line."""
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any axis failure
+            print(f"bench: {name} axis failed "
+                  f"(attempt {attempt + 1}/{retries + 1}): {e!r}",
+                  file=sys.stderr)
+    return None
+
+
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
@@ -995,52 +1013,22 @@ def run_bench() -> Dict[str, object]:
             proc.terminate()
             proc.wait(timeout=10)
         if os.access(DNSBLAST, os.X_OK):
-            try:
-                logged = _bench_logged(tmpdir)
-            except Exception as e:
-                print(f"bench: logged axis failed: {e!r}",
-                      file=sys.stderr)
-                logged = None
-            try:
-                tcp = _bench_tcp(tmpdir)
-            except Exception as e:
-                print(f"bench: tcp axis failed: {e!r}", file=sys.stderr)
-                tcp = None
-            # miss/churn are primary axes: a failure must be loud on
-            # stderr (stdout stays the single JSON line)
-            try:
-                miss = _bench_miss(tmpdir)
-            except Exception as e:
-                print(f"bench: miss axis failed: {e!r}", file=sys.stderr)
-                miss = None
-            try:
-                churn = _bench_churn(tmpdir)
-            except Exception as e:
-                print(f"bench: churn axis failed: {e!r}", file=sys.stderr)
-                churn = None
-            try:
-                recur = _bench_recursion(tmpdir)
-            except Exception as e:
-                print(f"bench: recursion axis failed: {e!r}",
-                      file=sys.stderr)
-                recur = None
+            logged = _try_axis("logged", lambda: _bench_logged(tmpdir))
+            tcp = _try_axis("tcp", lambda: _bench_tcp(tmpdir))
+            miss = _try_axis("miss", lambda: _bench_miss(tmpdir))
+            churn = _try_axis("churn", lambda: _bench_churn(tmpdir))
+            recur = _try_axis("recursion",
+                              lambda: _bench_recursion(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
-            try:
-                topo = _bench_topology(tmpdir)
-            except Exception:
-                topo = None   # topology figure is supplementary
-            try:
-                # balancer-overhead isolation (VERDICT r3 item 2): the
-                # SAME workload against ONE backend, balancer-fronted —
-                # compared against the direct headline (one backend, no
-                # balancer, same mix/driver/pinning) this isolates the
-                # balancer's own packet path from backend fan-out
-                fronted1 = _bench_topology(tmpdir, n_backends=1,
-                                           tag="f1")
-            except Exception as e:
-                print(f"bench: balancer-overhead axis failed: {e!r}",
-                      file=sys.stderr)
-                fronted1 = None
+            topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
+            # balancer-overhead isolation (VERDICT r3 item 2): the
+            # SAME workload against ONE backend, balancer-fronted —
+            # compared against the direct headline (one backend, no
+            # balancer, same mix/driver/pinning) this isolates the
+            # balancer's own packet path from backend fan-out
+            fronted1 = _try_axis(
+                "balancer-overhead",
+                lambda: _bench_topology(tmpdir, n_backends=1, tag="f1"))
 
     baseline = miss_baseline = None
     legacy_baseline = False   # round-1 file predating the miss axis
