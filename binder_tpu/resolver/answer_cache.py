@@ -27,7 +27,7 @@ Correctness properties:
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, Optional, Set
 
 
 class AnswerCache:
@@ -132,13 +132,6 @@ class AnswerCache:
                 n += 1
         self.invalidations += n
         return n
-
-    def variants(self, key, epoch: int) -> Optional[List[object]]:
-        """All collected variants for a live entry (fast-path push)."""
-        e = self._entries.get(key)
-        if e is None or e[0] != epoch:
-            return None
-        return list(e[3])
 
     def remaining_ttl_ms(self, key, epoch: int) -> Optional[float]:
         """Milliseconds until this entry's time expiry — a late-completed
