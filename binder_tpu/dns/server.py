@@ -37,6 +37,9 @@ except ImportError:  # pure-Python fallback: recvfrom/sendto per packet
 # socket-free serve entry for the TCP / balancer lanes (older builds of
 # the extension predate it)
 _fp_serve_wire = getattr(_fastio, "fastpath_serve_wire", None)
+# bulk TCP-frame serve: every complete frame in a read chunk handled in
+# one C call (hits framed back as one writer call; misses surfaced)
+_fp_serve_frames = getattr(_fastio, "fastpath_serve_frames", None)
 
 # Sentinel an on_query hook may return instead of an awaitable: the
 # query is in flight and the HANDLER owns its completion — response AND
@@ -287,34 +290,42 @@ class DnsServer:
             self._decode_cache[key] = msg
         return msg
 
+    def _fp_call(self, entry, payload: bytes, src, protocol: str):
+        """Shared plumbing for the socket-free native serve entries:
+        gate check, generation fetch, logged-posture signature (src
+        rides along ONLY when the log ring is armed, so an older
+        compiled extension's 3-arg form keeps working), and the
+        TypeError/ValueError fallback.  Returns the entry's result, or
+        None when the path is unavailable/declined."""
+        if (self.fastpath is None or entry is None
+                or (self.fastpath_gate is not None
+                    and not self.fastpath_gate())):
+            return None
+        try:
+            gen = self.fastpath_gen() if self.fastpath_gen else 0
+            if self.fastpath_log_flush is not None:
+                return entry(self.fastpath, payload, gen, src[0], src[1],
+                             protocol)
+            return entry(self.fastpath, payload, gen)
+        except (TypeError, ValueError):
+            return None
+
     def _handle_raw(self, data: bytes, src: Tuple[str, int],
                     protocol: str, send: Callable[[bytes], None],
                     client_transport: Optional[str] = None,
-                    ctx_box: Optional[list] = None) -> None:
+                    ctx_box: Optional[list] = None,
+                    fastpath_checked: bool = False) -> None:
         # Native answer-cache/zone serve for the lanes that have no C
         # drain of their own — TCP and the balancer socket.  Direct-UDP
-        # packets reaching here already missed inside fastpath_drain, so
-        # a second lookup would be pure waste.  Correct for every lane:
-        # entries hold only untruncated responses and decline when the
-        # assembled wire would exceed the query's advertised ceiling, so
-        # a TCP serve can never differ from the Python path's.
-        if (protocol != "udp" and self.fastpath is not None
-                and _fp_serve_wire is not None
-                and (self.fastpath_gate is None or self.fastpath_gate())):
-            try:
-                gen = self.fastpath_gen() if self.fastpath_gen else 0
-                # src/protocol ride along so the logged posture can emit
-                # this serve's log line from inside the C core; passed
-                # ONLY when the ring is armed so an older compiled
-                # extension (3-arg serve_wire) keeps working in the
-                # log-off posture instead of TypeError-ing per query
-                if self.fastpath_log_flush is not None:
-                    resp = _fp_serve_wire(self.fastpath, data, gen,
-                                          src[0], src[1], protocol)
-                else:
-                    resp = _fp_serve_wire(self.fastpath, data, gen)
-            except (TypeError, ValueError):
-                resp = None
+        # packets reaching here already missed inside fastpath_drain,
+        # and TCP payloads surfaced by the bulk frame serve arrive with
+        # fastpath_checked=True — a second lookup would be pure waste.
+        # Correct for every lane: entries hold only untruncated
+        # responses and decline when the assembled wire would exceed
+        # the query's advertised ceiling, so a TCP serve can never
+        # differ from the Python path's.
+        if protocol != "udp" and not fastpath_checked:
+            resp = self._fp_call(_fp_serve_wire, data, src, protocol)
             if resp is not None:
                 try:
                     send(resp)
@@ -606,20 +617,27 @@ class DnsServer:
         self._conns.add(writer)
         self._tcp_conns.add(writer)
 
-        def send(wire: bytes) -> None:
-            # responses are produced asynchronously, so the
-            # write-buffer bound lives here: a client that asks
-            # but never reads must cost O(cap), not OOM
+        def send_block(framed: bytes) -> None:
+            # pre-framed bytes (one response, or the native bulk
+            # serve's whole block); bound is cap plus at most one
+            # 64KB frame of overshoot — the same bound the
+            # per-response path always had — so a non-reading client
+            # costs O(cap), not O(cap + arena), even for bulk blocks
             transport = writer.transport
-            if (transport.get_write_buffer_size()
-                    > self.max_tcp_write_buffer):
+            buffered = transport.get_write_buffer_size()
+            if (buffered > self.max_tcp_write_buffer
+                    or buffered + len(framed)
+                    > self.max_tcp_write_buffer + 65538):
                 self.log.warning(
                     "TCP client %s not reading responses "
                     "(>%d bytes buffered), aborting", peer[0],
                     self.max_tcp_write_buffer)
                 transport.abort()
                 return
-            writer.write(struct.pack(">H", len(wire)) + wire)
+            writer.write(framed)
+
+        def send(wire: bytes) -> None:
+            send_block(struct.pack(">H", len(wire)) + wire)
 
         src = (peer[0], peer[1])
         buf = b""
@@ -642,6 +660,29 @@ class DnsServer:
                 # the TCP serve path)
                 buf = buf + chunk if buf else chunk
                 off = 0
+                # native bulk serve first: all complete frames the C
+                # cache/zone can answer are served and framed in ONE
+                # call + one writer.write; only misses (and frames past
+                # the C arena cap) fall through to the per-frame path
+                if len(buf) >= 2:
+                    bulk = self._fp_call(_fp_serve_frames, buf, src,
+                                         "tcp")
+                    if bulk is not None:
+                        resp, consumed, fmisses = bulk
+                        if resp:
+                            send_block(resp)
+                        for payload in fmisses:
+                            # already declined by the bulk serve: skip
+                            # the redundant per-payload fastpath probe
+                            self._handle_raw(payload, src, "tcp", send,
+                                             fastpath_checked=True)
+                        off = consumed
+                        if self.fastpath_log_flush is not None and resp:
+                            try:
+                                self.fastpath_log_flush()
+                            except Exception:
+                                self.log.exception(
+                                    "query-log ring drain failed")
                 n = len(buf)
                 while n - off >= 2:
                     length = (buf[off] << 8) | buf[off + 1]

@@ -283,6 +283,9 @@ static PyMethodDef fastio_methods[] = {
      "[, arcount]) -> bool"},
     {"fastpath_serve_wire", fastpath_serve_wire, METH_VARARGS,
      "fastpath_serve_wire(cache, packet, gen) -> bytes | None"},
+    {"fastpath_serve_frames", fastpath_serve_frames, METH_VARARGS,
+     "fastpath_serve_frames(cache, framed, gen[, client, port, proto])"
+     " -> (framed_responses, consumed, [miss_payload, ...])"},
     {"fastpath_drain", fastpath_drain, METH_VARARGS,
      "fastpath_drain(cache, fd, gen, max_n=64) -> (misses, served)"},
     {"fastpath_stats", fastpath_stats, METH_VARARGS,

@@ -442,6 +442,97 @@ fastpath_serve_wire(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_serve_frames(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    Py_buffer data;
+    unsigned long long gen;
+    const char *client = NULL;
+    const char *proto = "tcp";
+    unsigned port = 0;
+
+    if (!PyArg_ParseTuple(args, "Oy*K|sIs", &capsule, &data, &gen,
+                          &client, &port, &proto))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    fp_logsrc_t src = { client, port, proto };
+    fp_logsrc_t *srcp = client != NULL ? &src : NULL;
+
+    /* responses for every hit in the chunk, RFC 1035 framed, written
+     * back with ONE writer call; misses surface as payload bytes for
+     * the Python path.  Static arena is safe: the GIL is held for the
+     * whole call (like serve_wire's). */
+    static uint8_t out[262144];
+    size_t out_used = 0;
+    size_t consumed = 0;
+    const uint8_t *p = (const uint8_t *)data.buf;
+    size_t n = (size_t)data.len;
+    PyObject *misses = PyList_New(0);
+    if (misses == NULL) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+
+    while (consumed + 2 <= n) {
+        size_t flen = ((size_t)p[consumed] << 8) | p[consumed + 1];
+        if (flen == 0)
+            break;          /* protocol garbage: Python closes the conn */
+        if (consumed + 2 + flen > n)
+            break;          /* partial frame: caller keeps the tail */
+        if (out_used + 2 + FP_MAX_WIRE > sizeof(out))
+            break;          /* arena full: caller re-feeds the rest */
+        const uint8_t *pkt = p + consumed + 2;
+        uint16_t qtype = 0;
+        double t0 = fp_now();
+        /* decline_tc=1: cached TC wires must never replay over TCP */
+        size_t wlen = fp_serve_one_lx(c, pkt, flen, (uint64_t)gen, t0,
+                                      out + out_used + 2, &qtype, 1,
+                                      srcp);
+        if (wlen == 0) {
+            PyObject *payload = PyBytes_FromStringAndSize(
+                (const char *)pkt, (Py_ssize_t)flen);
+            int rc = payload == NULL ? -1
+                : PyList_Append(misses, payload);
+            Py_XDECREF(payload);
+            if (rc < 0) {
+                Py_DECREF(misses);
+                PyBuffer_Release(&data);
+                return NULL;
+            }
+        } else {
+            out[out_used] = (uint8_t)(wlen >> 8);
+            out[out_used + 1] = (uint8_t)(wlen & 0xFF);
+            out_used += 2 + wlen;
+            /* same per-qtype accounting as serve_wire */
+            fp_qstat_t *qs = fp_qstat(c, qtype);
+            double elapsed = fp_now() - t0;
+            qs->count++;
+            qs->lat_sum += elapsed;
+            qs->lat_cells[fp_bucket_index(c->lat_buckets,
+                                          c->n_lat_buckets, elapsed)]++;
+            qs->size_sum += (double)wlen;
+            qs->size_cells[fp_bucket_index(c->size_buckets,
+                                           c->n_size_buckets,
+                                           (double)wlen)]++;
+        }
+        consumed += 2 + flen;
+    }
+    PyBuffer_Release(&data);
+    PyObject *resp = PyBytes_FromStringAndSize((const char *)out,
+                                               (Py_ssize_t)out_used);
+    if (resp == NULL) {
+        Py_DECREF(misses);
+        return NULL;
+    }
+    return Py_BuildValue("(NnN)", resp, (Py_ssize_t)consumed, misses);
+}
+
+PyObject *
 fastpath_invalidate(PyObject *self, PyObject *args)
 {
     (void)self;

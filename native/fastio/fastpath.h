@@ -27,6 +27,7 @@ PyObject *fastpath_new(PyObject *self, PyObject *args);
 PyObject *fastpath_put(PyObject *self, PyObject *args);
 PyObject *fastpath_zone_put(PyObject *self, PyObject *args);
 PyObject *fastpath_serve_wire(PyObject *self, PyObject *args);
+PyObject *fastpath_serve_frames(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);

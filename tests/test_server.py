@@ -780,3 +780,48 @@ class TestPairBindAnnouncement:
         import logging as _logging
         caplog.set_level(_logging.INFO, logger="binder.server")
         asyncio.run(run())
+
+
+class TestTcpBulkServe:
+    def test_mixed_hit_miss_pipelined_chunk(self):
+        """One write carrying interleaved zone-served and
+        Python-resolved frames: every query must be answered correctly
+        by id whatever path served it (the native bulk frame serve
+        splits a chunk into C-served hits and surfaced misses)."""
+        async def run():
+            store, cache = fixture_store()
+            server = await start_server(cache)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.tcp_port)
+            names = [("web.foo.com", Type.A),        # zone hit
+                     ("nope.example.org", Type.A),   # REFUSED via Python
+                     ("web.foo.com", Type.A),        # zone hit
+                     ("_pg._tcp.svc.foo.com", Type.SRV),  # zone SRV
+                     ("nope2.example.org", Type.A)]  # Python again
+            block = b""
+            for qid, (name, qt) in enumerate(names, start=1):
+                wire = make_query(name, qt, qid=qid).encode()
+                block += struct.pack(">H", len(wire)) + wire
+            writer.write(block)
+            await writer.drain()
+            got = {}
+            buf = b""
+            while len(got) < len(names):
+                buf += await reader.read(65536)
+                while len(buf) >= 2:
+                    (ln,) = struct.unpack(">H", buf[:2])
+                    if len(buf) - 2 < ln:
+                        break
+                    m = Message.decode(buf[2:2 + ln])
+                    buf = buf[2 + ln:]
+                    got[m.id] = m
+            assert got[1].answers[0].address == "192.168.0.1"
+            assert got[2].rcode == Rcode.REFUSED
+            assert got[3].answers[0].address == "192.168.0.1"
+            assert got[4].answers[0].port == 5432
+            assert got[5].rcode == Rcode.REFUSED
+            writer.close()
+            await writer.wait_closed()
+            await server.stop()
+
+        asyncio.run(run())
