@@ -913,17 +913,27 @@ def _bench_recursion(tmpdir: str) -> Dict[str, float]:
 def _launch_balancer(sockdir: str):
     """Start mbalancer on an ephemeral port fronting `sockdir`; returns
     (proc, port).  Shared by the topology and balancer-churn axes so
-    both measure an identically configured balancer."""
-    bal = subprocess.Popen(
-        _pin("server")
-        + [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-           "-s", "300"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    both measure an identically configured balancer.  stderr goes to a
+    file beside the sockets so a startup death is diagnosable (it has
+    been observed transiently under full-bench load) without risking a
+    blocking pipe mid-run."""
+    errpath = os.path.join(sockdir, ".balancer.stderr")
+    with open(errpath, "wb") as errf:
+        bal = subprocess.Popen(
+            _pin("server")
+            + [MBALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+               "-s", "300"],
+            stdout=subprocess.PIPE, stderr=errf)
     try:
         port = _wait_for_line(bal, rb"PORT (\d+)\n", "mbalancer")
-    except Exception:
+    except Exception as e:
         _reap(bal)
-        raise
+        try:
+            with open(errpath, "rb") as f:
+                tail = f.read()[-400:].decode("utf-8", "replace")
+        except OSError:
+            tail = ""
+        raise RuntimeError(f"{e}; mbalancer stderr: {tail!r}") from e
     return bal, port
 
 
