@@ -1386,12 +1386,16 @@ void handle_stats() {
  * so "::" serves both stacks — v4 clients appear as v4-mapped v6
  * addresses, which the frame protocol and backends already carry as
  * family-6). Default stays "0.0.0.0". */
-int listen_front(int socktype, const char *what) {
+/* `fatal=false` returns -1 on EADDRINUSE instead of dying — used by
+ * the ephemeral pair-bind retry, where a collision on the UDP-chosen
+ * port just means redraw. */
+int listen_front(int socktype, const char *what, bool fatal = true) {
     bool v6 = g_bal.bind_addr.find(':') != std::string::npos;
     int fd = socket(v6 ? AF_INET6 : AF_INET, socktype | SOCK_NONBLOCK, 0);
     if (fd < 0) { perror(what); exit(1); }
     int one = 1;
     setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    int rc;
     if (v6) {
         int zero = 0;
         setsockopt(fd, IPPROTO_IPV6, IPV6_V6ONLY, &zero, sizeof(zero));
@@ -1404,10 +1408,7 @@ int listen_front(int socktype, const char *what) {
                     g_bal.bind_addr.c_str());
             exit(1);
         }
-        if (bind(fd, (struct sockaddr *)&sin6, sizeof(sin6)) != 0) {
-            perror(what);
-            exit(1);
-        }
+        rc = bind(fd, (struct sockaddr *)&sin6, sizeof(sin6));
     } else {
         struct sockaddr_in sin{};
         sin.sin_family = AF_INET;
@@ -1418,10 +1419,15 @@ int listen_front(int socktype, const char *what) {
                     g_bal.bind_addr.c_str());
             exit(1);
         }
-        if (bind(fd, (struct sockaddr *)&sin, sizeof(sin)) != 0) {
-            perror(what);
-            exit(1);
+        rc = bind(fd, (struct sockaddr *)&sin, sizeof(sin));
+    }
+    if (rc != 0) {
+        if (!fatal && errno == EADDRINUSE) {
+            close(fd);
+            return -1;
         }
+        perror(what);
+        exit(1);
     }
     return fd;
 }
@@ -1430,9 +1436,21 @@ int listen_udp() {
     return listen_front(SOCK_DGRAM, "bind udp");
 }
 
-int listen_tcp() {
-    int fd = listen_front(SOCK_STREAM, "bind tcp");
-    if (listen(fd, 128) != 0) { perror("listen tcp"); exit(1); }
+int listen_tcp(bool fatal = true) {
+    int fd = listen_front(SOCK_STREAM, "bind tcp", fatal);
+    if (fd < 0)
+        return -1;
+    if (listen(fd, 128) != 0) {
+        /* with SO_REUSEADDR a colliding port can pass bind() and fail
+         * only here (peer still in its own bind->listen window): the
+         * non-fatal caller's redraw loop must handle that shape too */
+        if (!fatal && errno == EADDRINUSE) {
+            close(fd);
+            return -1;
+        }
+        perror("listen tcp");
+        exit(1);
+    }
     return fd;
 }
 
@@ -1502,12 +1520,29 @@ int main(int argc, char **argv) {
     g_bal.tcp_fd = listen_tcp();
     g_bal.stats_fd = listen_stats();
 
-    /* Both fronts bind the same port number: if -p 0, rebind TCP to the
-     * UDP-chosen port for parity with production (:53/:53). */
+    /* Both fronts bind the same port number (production :53/:53).
+     * With -p 0 the kernel picks the UDP port — a number any unrelated
+     * socket may already hold on TCP — so the rebind is a retry loop:
+     * release the draw and redraw instead of dying (observed as a
+     * transient bench startup death, "bind tcp: Address already in
+     * use"; the backend's ephemeral pair bind handles the same race
+     * the same way). */
     if (g_bal.port == 0) {
         close(g_bal.tcp_fd);
-        g_bal.port = local_port(g_bal.udp_fd);
-        g_bal.tcp_fd = listen_tcp();
+        for (int attempt = 0; ; attempt++) {
+            g_bal.port = local_port(g_bal.udp_fd);
+            g_bal.tcp_fd = listen_tcp(/*fatal=*/false);
+            if (g_bal.tcp_fd >= 0)
+                break;
+            if (attempt >= 15) {
+                fprintf(stderr,
+                        "mbalancer: no bindable udp/tcp port pair\n");
+                exit(1);
+            }
+            close(g_bal.udp_fd);
+            g_bal.port = 0;
+            g_bal.udp_fd = listen_udp();
+        }
     }
 
     g_bal.timer_fd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);

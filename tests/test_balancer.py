@@ -8,6 +8,7 @@ zero automated tests").
 import asyncio
 import json
 import os
+import socket
 import struct
 
 import pytest
@@ -675,3 +676,50 @@ class TestBalancerBounds:
         stats, sent = asyncio.run(run())
         assert stats["wq_overflows"] >= 1, stats
         assert stats["tcp_clients"] == 0, stats
+
+
+@pytest.mark.skipif(not os.path.exists(BALANCER),
+                    reason="mbalancer not built")
+def test_ephemeral_pair_bind_survives_tcp_squatters(tmp_path):
+    """mbalancer -p 0 binds UDP first and rebinds TCP to that number —
+    which any unrelated socket may hold (observed in a full-bench run:
+    'bind tcp: Address already in use' startup death). With a big slice
+    of the ephemeral range squatted on TCP, repeated starts must always
+    come up and answer on the advertised UDP port (the pair-bind retry
+    redraws instead of dying)."""
+    async def run():
+        squatters = []
+        try:
+            for _ in range(1500):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    s.bind(("127.0.0.1", 0))
+                    s.listen(1)
+                except OSError:
+                    s.close()
+                    break
+                squatters.append(s)
+            for i in range(30):
+                proc, port = await start_balancer(str(tmp_path))
+                try:
+                    # advertised port must actually be HELD on UDP:
+                    # binding it ourselves must fail (a UDP connect()
+                    # would succeed even against a dead port)
+                    probe = socket.socket(socket.AF_INET,
+                                          socket.SOCK_DGRAM)
+                    try:
+                        probe.bind(("127.0.0.1", port))
+                        raise AssertionError(
+                            f"advertised UDP port {port} not held")
+                    except OSError:
+                        pass
+                    finally:
+                        probe.close()
+                finally:
+                    proc.kill()
+                    await proc.wait()
+        finally:
+            for s in squatters:
+                s.close()
+
+    asyncio.run(run())
