@@ -15,6 +15,8 @@ once; the server engine then emits the ``after`` event for metrics/logging
 """
 from __future__ import annotations
 
+import itertools
+import os
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -27,13 +29,27 @@ from binder_tpu.dns.wire import (
 
 _ECHO_OPT = OPTRecord(name="", ttl=0, udp_payload_size=1232)
 
+# Per-query trace IDs: "<pid hex>-<seq hex>", unique within a process
+# for the life of the counter and distinguishable across the N-process
+# deployment unit.  itertools.count.__next__ is a single C call, so
+# concurrent allocation (scrape threads, tests) can never hand two
+# queries the same sequence number.
+_TRACE_SEQ = itertools.count(1)
+_TRACE_PREFIX = f"{os.getpid():x}-"
+
+
+def next_trace_id() -> str:
+    """Allocate a process-unique query trace ID (the attribution key
+    carried through probes, phase stamps, and the query log)."""
+    return _TRACE_PREFIX + format(next(_TRACE_SEQ), "x")
+
 
 class QueryCtx:
     __slots__ = ("request", "response", "src", "protocol",
                  "client_transport", "_send", "_responded", "bytes_sent",
                  "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
                  "cached_summary", "no_store", "dep_domain",
-                 "want_log_detail")
+                 "want_log_detail", "trace_id")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -74,6 +90,11 @@ class QueryCtx:
         self._last_stamp = self.start
         self.times: Dict[str, float] = {}
         self.log_ctx: Dict[str, object] = {}
+        # attribution identity: carried by probes, the query log, and
+        # the per-stage stamps so one query's hops correlate across
+        # layers (the reference correlates dtrace op-req-start/done by
+        # the lazily-built JSON args; here the ID is explicit)
+        self.trace_id = next_trace_id()
 
         self.response = Message(
             id=request.id, qr=True, opcode=request.opcode, aa=True,
@@ -120,9 +141,20 @@ class QueryCtx:
     # -- timers (lib/server.js:476-483) --
 
     def stamp(self, name: str) -> None:
+        """Record the time (ms) since the previous stamp under ``name``
+        and advance the cursor — consecutive stamps decompose the
+        query's latency into non-overlapping phases (monotonic clock, so
+        every recorded delta is >= 0)."""
         now = time.monotonic()
         self.times[name] = (now - self._last_stamp) * 1000.0
         self._last_stamp = now
+
+    def record_phase(self, name: str, ms: float) -> None:
+        """Record an externally measured phase duration (ms) WITHOUT
+        moving the stamp cursor — for spans another layer timed itself
+        (upstream RTT measured by the DNS client, event-loop wait
+        measured at callback entry) that overlap the stamp timeline."""
+        self.times[name] = ms
 
     def latency_ms(self) -> float:
         return (time.monotonic() - self.start) * 1000.0

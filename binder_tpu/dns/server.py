@@ -650,8 +650,15 @@ class DnsServer:
                 # is dispatched: a client trickling one byte per read
                 # ("slowloris") gets the same whole-frame deadline as a
                 # silent one
-                async with asyncio.timeout_at(deadline):
+                # asyncio.timeout_at is 3.11+; wait_for against the
+                # remaining budget gives the same whole-frame deadline
+                # on every supported interpreter
+                if deadline is None:
                     chunk = await reader.read(65536)
+                else:
+                    chunk = await asyncio.wait_for(
+                        reader.read(65536),
+                        max(0.0, deadline - loop.time()))
                 if not chunk:
                     break
                 # bulk reframe: every complete frame in the chunk is
@@ -705,7 +712,8 @@ class DnsServer:
                     deadline = loop.time() + idle
         except ConnectionResetError:
             pass
-        except TimeoutError:
+        except (TimeoutError, asyncio.TimeoutError):
+            # asyncio.TimeoutError is a distinct class until 3.11
             self.log.debug("closing idle TCP connection from %s", peer[0])
         finally:
             self._conns.discard(writer)

@@ -25,6 +25,12 @@ DEFAULT_LATENCY_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 DEFAULT_SIZE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+# Per-stage attribution histograms (binder_query_stage_seconds): single
+# phases run from a few µs (mirror probe, splice) up to cross-DC RTTs in
+# ms, so the grid extends two decades below the request-latency buckets.
+DEFAULT_STAGE_BUCKETS = (
+    0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 
 
 def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -202,12 +208,16 @@ class Histogram:
             running = 0
             for i, b in enumerate(self.buckets):
                 running += cells[i]
+                # no escapes inside f-string expressions (a backslash
+                # there is a SyntaxError before Python 3.12)
+                le = 'le="%g"' % b
                 lines.append(
-                    f"{self.name}_bucket"
-                    f"{_fmt_labels(full, f'le=\"{b:g}\"')} {running}")
+                    f"{self.name}_bucket{_fmt_labels(full, le)} "
+                    f"{running}")
             total = running + cells[len(self.buckets)]
+            inf = 'le="+Inf"'
             lines.append(f"{self.name}_bucket"
-                         f"{_fmt_labels(full, 'le=\"+Inf\"')} {total}")
+                         f"{_fmt_labels(full, inf)} {total}")
             lines.append(f"{self.name}_sum{_fmt_labels(full)} "
                          f"{self._sums.get(key, 0.0):g}")
             lines.append(f"{self.name}_count{_fmt_labels(full)} {total}")

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from binder_tpu.dns.wire import (Message, Rcode, Record,
@@ -102,7 +103,12 @@ class _PortProto(asyncio.DatagramProtocol):
         del self.pending[(data[0] << 8) | data[1]]
         # validated raw bytes (id + verbatim question echo); decoding is
         # deferred to the consumer — the splice path (recursion.py)
-        # forwards the wire without ever building record objects
+        # forwards the wire without ever building record objects.
+        # Arrival stamp rides the future: the gap between this moment
+        # and the done-callback running is event-loop wait, the half of
+        # recursive latency the attribution layer must separate from
+        # the upstream RTT (recursion._complete reads it back).
+        fut.binder_recv_t = time.monotonic()
         fut.set_result(bytes(data))
 
     def _fail_all(self, exc) -> None:

@@ -174,7 +174,9 @@ class Resolver:
         # mutates in the store — note for SRV this is the *service node*
         # domain, not the _svc._proto-prefixed qname
         query.dep_domain = domain
-        node = self.cache.lookup(domain)
+        # traced: stamps "store-lookup" (decode→policy→mirror probe) on
+        # the query's attribution timeline
+        node = self.cache.lookup_traced(domain, query)
 
         if node is None:
             if self.recursion is not None and query.rd():
@@ -309,7 +311,7 @@ class Resolver:
         # dependency tag: mutations touching this address emit the
         # normalized reverse qname (store/cache.py _rev_name)
         query.dep_domain = domain.lower()
-        node = self.cache.reverse_lookup(ip)
+        node = self.cache.reverse_lookup_traced(ip, query)
         if node is None:
             if self.recursion is not None and query.rd():
                 query.no_store = True

@@ -42,6 +42,7 @@ from binder_tpu.dns.wire import (
 )
 from binder_tpu.metrics.collector import (
     DEFAULT_SIZE_BUCKETS,
+    DEFAULT_STAGE_BUCKETS,
     MetricsCollector,
 )
 from binder_tpu.resolver.answer_cache import AnswerCache
@@ -57,6 +58,10 @@ from binder_tpu.utils.probes import ProbeProvider
 METRIC_REQUEST_COUNTER = "binder_requests_completed"
 METRIC_LATENCY_HISTOGRAM = "binder_request_latency_seconds"
 METRIC_SIZE_HISTOGRAM = "binder_response_size_bytes"
+# per-stage attribution: one histogram, labeled by stage, fed from the
+# QueryCtx phase stamps at after-hook time — the scrapeable form of the
+# query log's `timers` dict (same stage names)
+METRIC_STAGE_HISTOGRAM = "binder_query_stage_seconds"
 
 SLOW_QUERY_MS = 1000.0  # log at warn above this (lib/server.js:511-514)
 
@@ -180,9 +185,15 @@ class BinderServer:
         self.size_histogram = self.collector.histogram(
             METRIC_SIZE_HISTOGRAM, "size in bytes of Binder responses",
             buckets=DEFAULT_SIZE_BUCKETS)
+        self.stage_histogram = self.collector.histogram(
+            METRIC_STAGE_HISTOGRAM,
+            "per-stage decomposition of request processing time",
+            buckets=DEFAULT_STAGE_BUCKETS)
         # per-qtype pre-resolved metric handles (label-sort once, not
         # per query); key is the numeric qtype
         self._metric_children: dict = {}
+        # per-stage pre-resolved histogram handles, keyed by stage name
+        self._stage_children: dict = {}
 
         # USDT analog: provider 'binder', probes op-req-start/op-req-done
         # fired with the query context (lib/server.js:24-29,472-474,516-518)
@@ -354,6 +365,7 @@ class BinderServer:
             query.want_log_detail = True
         if self.p_req_start.enabled:   # skip closure alloc when off
             self.p_req_start.fire(lambda: {
+                "trace": query.trace_id,
                 "id": query.request.id, "name": query.name(),
                 "type": query.qtype_name(), "client": query.src[0],
                 "protocol": query.protocol,
@@ -378,6 +390,7 @@ class BinderServer:
                 query.response.rcode = wire[3] & 0x0F  # for metrics/logs
                 query.log_ctx["cached"] = True
                 query.cached_summary = (ans, add)
+                query.stamp("cache-hit")   # decode→probe→serve, whole hit
                 query.respond_raw(wire)
                 # promote-on-first-hit: a repeat proves the name is hot,
                 # so hand the entry to the C fast path NOW (resolve-time
@@ -1450,10 +1463,13 @@ class BinderServer:
         lat_ms = query.latency_ms()
         if self.p_req_done.enabled:
             self.p_req_done.fire(lambda: {
+                "trace": query.trace_id,
                 "id": query.request.id, "name": query.name(),
                 "type": query.qtype_name(),
                 "rcode": Rcode.name(query.rcode()),
                 "latency_ms": round(lat_ms, 3), "bytes": query.bytes_sent,
+                "stages": {k: round(v, 3)
+                           for k, v in query.times.items()},
             })
         level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
 
@@ -1461,6 +1477,12 @@ class BinderServer:
         children[0].inc()
         children[1].observe(lat_ms / 1000.0)
         children[2].observe(query.bytes_sent)
+        for stage, ms in query.times.items():
+            child = self._stage_children.get(stage)
+            if child is None:
+                child = self._stage_children[stage] = \
+                    self.stage_histogram.labelled({"stage": stage})
+            child.observe(ms / 1000.0)
 
         if not self.query_log and lat_ms <= SLOW_QUERY_MS:
             return
@@ -1475,6 +1497,7 @@ class BinderServer:
             # request envelope built here, not per-query in _on_query:
             # most queries never log (queryLog off / fast), so the dict
             # work happens only on the slow/logged path
+            trace=query.trace_id,
             req_id=query.request.id,
             client=query.src[0],
             port=f"{query.src[1]}/{query.protocol}",

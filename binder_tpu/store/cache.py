@@ -308,6 +308,23 @@ class MirrorCache:
     def reverse_lookup(self, ip: str) -> Optional[TreeNode]:
         return self.rev_lookup.get(ip)
 
+    # -- traced entry points (per-stage attribution) --
+    #
+    # The resolver hands its QueryCtx in so the mirror probe gets its
+    # own phase stamp ("store-lookup") on the query's attribution
+    # timeline; the lookup itself is identical.  Kept as separate
+    # methods so non-query callers (zone refresh, tests) pay nothing.
+
+    def lookup_traced(self, domain: str, query) -> Optional[TreeNode]:
+        node = self.nodes.get(domain)
+        query.stamp("store-lookup")
+        return node
+
+    def reverse_lookup_traced(self, ip: str, query) -> Optional[TreeNode]:
+        node = self.rev_lookup.get(ip)
+        query.stamp("store-lookup")
+        return node
+
     def rebuild(self) -> None:
         """Re-mirror from scratch-or-current on (re)session
         (lib/zk.js:68-76)."""

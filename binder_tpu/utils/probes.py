@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("binder.probes")
@@ -42,6 +43,11 @@ class Probe:
 
     def fire(self, argf: Callable[[], object]) -> None:
         """Evaluate ``argf`` and deliver only if somebody is listening."""
+        # snapshot: _sinks is replaced wholesale (copy-on-write under the
+        # provider lock), never mutated in place, so this local reference
+        # is a stable list even while another thread (a test detaching
+        # its sink mid-load, the ftrace close path) subscribes or
+        # unsubscribes concurrently — no sink is skipped, nothing raises
         sinks = self.provider._sinks
         if not sinks:
             return
@@ -69,14 +75,17 @@ class ProbeProvider:
                  backend: Optional[str] = None) -> None:
         self.name = name
         self._probes: Dict[str, Probe] = {}
+        # copy-on-write: mutated only by replacement under _sinks_lock;
+        # Probe.fire() iterates a snapshot reference without the lock
         self._sinks: List[Callable[[str, object], None]] = []
+        self._sinks_lock = threading.Lock()
         self._marker = None
         backend = (backend if backend is not None
                    else os.environ.get("BINDER_PROBES", "off")).lower()
         if backend == "ftrace":
             self._attach_ftrace()
         elif backend == "log":
-            self._sinks.append(self._log_sink)
+            self.subscribe(self._log_sink)
         # anything else (off/unknown): no sinks, probes disabled
 
     def probe(self, probe_name: str) -> Probe:
@@ -86,13 +95,17 @@ class ProbeProvider:
         return p
 
     def subscribe(self, fn: Callable[[str, object], None]) -> None:
-        self._sinks.append(fn)
+        with self._sinks_lock:
+            self._sinks = self._sinks + [fn]
 
     def unsubscribe(self, fn: Callable[[str, object], None]) -> None:
-        try:
-            self._sinks.remove(fn)
-        except ValueError:
-            pass
+        with self._sinks_lock:
+            sinks = list(self._sinks)
+            try:
+                sinks.remove(fn)
+            except ValueError:
+                return
+            self._sinks = sinks
 
     # -- backends --
 
@@ -100,7 +113,7 @@ class ProbeProvider:
         for path in self.TRACE_MARKER_PATHS:
             try:
                 self._marker = open(path, "w", buffering=1)
-                self._sinks.append(self._ftrace_sink)
+                self.subscribe(self._ftrace_sink)
                 log.info("probes: ftrace markers to %s", path)
                 return
             except OSError:

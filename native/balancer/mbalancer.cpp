@@ -97,6 +97,73 @@ uint64_t now_ms() {
     return (uint64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
 
+/* ---- per-stage cycle counters ----
+ *
+ * Decompose the balancer's own packet path: where inside a query's
+ * transit through this process do the cycles go?  Four stages cover
+ * every hop a frame takes:
+ *
+ *   frame-parse    frame/datagram walk: backend frame validation and
+ *                  control handling, TCP client reframing — excluding
+ *                  the nested stages below
+ *   cache-probe    answer-cache work: key build, lookup, hit serve,
+ *                  response harvest into the cache
+ *   backend-write  query frame build + queue + the writev flush
+ *                  toward backends
+ *   reply-relay    response routing to clients (UDP sendmmsg batch
+ *                  add/flush, TCP framed write)
+ *
+ * Counters are raw TSC cycles on x86 (CLOCK_MONOTONIC ns elsewhere);
+ * one pair of reads per region ~10ns, cheap enough to stay always-on.
+ * `cycles_per_us` is calibrated over process lifetime at stats-read
+ * time, so consumers (balstat, bench) convert without knowing the TSC
+ * rate.  Nested regions subtract out: a stage's cycles are exclusive,
+ * so the four cells sum to the balancer's total attributable work and
+ * a share-of-total per stage is meaningful. */
+static inline uint64_t cycles_now() {
+#if defined(__x86_64__) || defined(__i386__)
+    unsigned lo, hi;
+    __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+    return ((uint64_t)hi << 32) | lo;
+#else
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+#endif
+}
+
+struct StageCell {
+    uint64_t cycles = 0;
+    uint64_t ops = 0;
+};
+struct StageCounters {
+    StageCell frame_parse, cache_probe, backend_write, reply_relay;
+};
+StageCounters g_stages;
+/* gross cycles of completed nested scopes, reported upward so an
+ * enclosing stage times only its own work (single-threaded loop: a
+ * plain global is the whole mechanism) */
+uint64_t g_nested_cycles = 0;
+uint64_t g_cal_cycles0 = 0;     /* lifetime calibration anchors (main) */
+double g_cal_mono0 = 0.0;
+
+struct ScopedStage {
+    StageCell &cell;
+    uint64_t t0, nested0;
+    explicit ScopedStage(StageCell &c)
+        : cell(c), t0(cycles_now()), nested0(g_nested_cycles) {}
+    ~ScopedStage() {
+        uint64_t gross = cycles_now() - t0;
+        uint64_t nested = g_nested_cycles - nested0;
+        cell.cycles += gross > nested ? gross - nested : 0;
+        cell.ops++;
+        /* replace (not add to) the nested tally: our gross span already
+         * contains any grandchildren, so the parent must subtract this
+         * span exactly once */
+        g_nested_cycles = nested0 + gross;
+    }
+};
+
 /* ---- client address key: family + 16 bytes + port ---- */
 struct ClientKey {
     uint8_t family;
@@ -705,6 +772,7 @@ void forward_query_to(int idx, const ClientKey &client, uint8_t transport,
         g_bal.wq_overflows++;
         return;
     }
+    ScopedStage _ss(g_stages.backend_write);
     be.conn.queue_write(make_frame(client, transport, payload, len));
     if (be.conn.wq_bytes > g_bal.backend_wq_peak)
         g_bal.backend_wq_peak = be.conn.wq_bytes;
@@ -728,6 +796,8 @@ void forward_query(const ClientKey &client, uint8_t transport,
 }
 
 void flush_pending_backends() {
+    if (g_flush_pending.empty()) return;
+    ScopedStage _ss(g_stages.backend_write);
     for (int idx : g_flush_pending) {
         Backend &be = g_bal.backends[idx];
         be.flush_pending = false;
@@ -769,6 +839,8 @@ struct UdpOut {
 } g_udp_out;
 
 void udp_out_flush() {
+    if (g_udp_out.n == 0) return;
+    ScopedStage _ss(g_stages.reply_relay);
     int off = 0;
     while (off < g_udp_out.n) {
         int sent = sendmmsg(g_bal.udp_fd, g_udp_out.msgs + off,
@@ -861,6 +933,10 @@ void handle_udp() {
             ClientKey ck = key_from_sockaddr(addrs[i]);
 
             if (g_bal.cache_ms > 0) {
+                /* attribution: key build + affinity pick + cache
+                 * lookup + hit serve / miss record (the nested
+                 * backend-write on a miss subtracts itself out) */
+                ScopedStage _probe(g_stages.cache_probe);
                 uint8_t key[DNSKEY_MAX];
                 size_t qn_len = 0;
                 uint16_t qtype = 0;
@@ -1019,12 +1095,16 @@ void handle_tcp_client(int fd, uint32_t events) {
         rb.insert(rb.end(), buf, buf + n);
         /* RFC 1035 4.2.2 framing: u16 length + message */
         size_t off = 0;
-        while (rb.size() - off >= 2) {
-            uint16_t mlen = (uint16_t)((rb[off] << 8) | rb[off + 1]);
-            if (rb.size() - off - 2 < mlen) break;
-            g_bal.tcp_queries++;
-            forward_query(tc.key, kTransportTcp, rb.data() + off + 2, mlen);
-            off += 2 + mlen;
+        {
+            ScopedStage _parse(g_stages.frame_parse);
+            while (rb.size() - off >= 2) {
+                uint16_t mlen = (uint16_t)((rb[off] << 8) | rb[off + 1]);
+                if (rb.size() - off - 2 < mlen) break;
+                g_bal.tcp_queries++;
+                forward_query(tc.key, kTransportTcp,
+                              rb.data() + off + 2, mlen);
+                off += 2 + mlen;
+            }
         }
         if (off > 0) rb.erase(rb.begin(), rb.begin() + off);
         if (rb.size() > kMaxFrame) {  /* garbage flood */
@@ -1086,6 +1166,7 @@ void maybe_cache_fill(Backend &be, uint8_t family, const uint8_t *addr16,
                       uint16_t port, const uint8_t *payload, size_t len) {
     if (!be.gen_known || len < 12 + 5 || len > kMaxCacheWire)
         return;
+    ScopedStage _ss(g_stages.cache_probe);
     ClientKey ck{};
     ck.family = family;
     memcpy(ck.addr, addr16, 16);
@@ -1120,6 +1201,7 @@ void maybe_cache_fill(Backend &be, uint8_t family, const uint8_t *addr16,
 void route_response(uint8_t family, uint8_t transport,
                     const uint8_t *addr16, uint16_t port,
                     const uint8_t *payload, size_t len) {
+    ScopedStage _ss(g_stages.reply_relay);
     ClientKey k{};
     k.family = family;
     memcpy(k.addr, addr16, 16);
@@ -1169,6 +1251,10 @@ void route_response(uint8_t family, uint8_t transport,
  * frame parser can be driven directly with hostile bytes (fuzz target
  * native/fuzz/fuzz_frames.cpp). */
 bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
+    /* attribution: the frame walk itself; the nested cache-probe
+     * (maybe_cache_fill) and reply-relay (route_response, the batched
+     * udp_out_flush) scopes subtract themselves out */
+    ScopedStage _ss(g_stages.frame_parse);
     auto &rb = be.conn.rbuf;
     rb.insert(rb.end(), buf, buf + n);
     size_t off = 0;
@@ -1347,7 +1433,34 @@ void handle_stats() {
                      (unsigned long long)g_bal.fwd_rtt_cells[c]);
             out += line;
         }
-        out += "],\n  \"backends\": [\n";
+        out += "],\n";
+        /* per-stage cycle attribution (see the StageCounters comment):
+         * exclusive cycles + timed-region count per stage, plus the
+         * lifetime-calibrated TSC rate so consumers convert to µs */
+        {
+            double cal_us = (mono_s() - g_cal_mono0) * 1e6;
+            double cpu = cal_us > 0.0
+                ? (double)(cycles_now() - g_cal_cycles0) / cal_us : 0.0;
+            snprintf(line, sizeof(line),
+                     "  \"cycles_per_us\": %.1f,\n"
+                     "  \"stage_cycles\": {\n"
+                     "    \"frame-parse\": {\"cycles\": %llu, \"ops\": %llu},\n"
+                     "    \"cache-probe\": {\"cycles\": %llu, \"ops\": %llu},\n"
+                     "    \"backend-write\": {\"cycles\": %llu, \"ops\": %llu},\n"
+                     "    \"reply-relay\": {\"cycles\": %llu, \"ops\": %llu}\n"
+                     "  },\n",
+                     cpu,
+                     (unsigned long long)g_stages.frame_parse.cycles,
+                     (unsigned long long)g_stages.frame_parse.ops,
+                     (unsigned long long)g_stages.cache_probe.cycles,
+                     (unsigned long long)g_stages.cache_probe.ops,
+                     (unsigned long long)g_stages.backend_write.cycles,
+                     (unsigned long long)g_stages.backend_write.ops,
+                     (unsigned long long)g_stages.reply_relay.cycles,
+                     (unsigned long long)g_stages.reply_relay.ops);
+            out += line;
+        }
+        out += "  \"backends\": [\n";
         /* one pass over the affinity map (reference be_remotes), not
          * one scan per backend */
         std::vector<size_t> remote_counts(g_bal.backends.size(), 0);
@@ -1514,6 +1627,8 @@ int main(int argc, char **argv) {
     signal(SIGPIPE, SIG_IGN);
     load_bound_overrides();
     g_bal.started_at = now_ms();
+    g_cal_cycles0 = cycles_now();   /* TSC-rate calibration anchors */
+    g_cal_mono0 = mono_s();
 
     g_bal.epfd = epoll_create1(0);
     g_bal.udp_fd = listen_udp();

@@ -1,0 +1,431 @@
+"""Per-stage attribution layer: trace IDs, phase stamps, stage metrics.
+
+The observability surface this pins down end to end:
+
+- every query gets a process-unique trace ID, carried by the
+  op-req-start/op-req-done probes and the query log, stable under
+  concurrent allocation (threads and overlapping in-flight queries);
+- the QueryCtx phase stamps decompose a query's latency into
+  non-negative phases whose names are complete for each serve path
+  (answer-cache hit, store miss, recursion fast path, TCP);
+- the `binder_query_stage_seconds` histogram agrees with
+  `binder_requests_completed` (every after-hook observation lands in
+  both), and the whole scrape text passes the Prometheus-exposition
+  validator (tools/lint.py) — malformed exposition fails tier-1 here;
+- the balancer's stats-socket `stage_cycles` counters are present,
+  consistent with its own query counters and with the backend's
+  `binder_requests_completed`.
+"""
+import asyncio
+import json
+import os
+import socket
+import struct
+import threading
+
+import pytest
+
+from binder_tpu.dns import Message, Type, make_query
+from binder_tpu.dns.query import next_trace_id
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import (
+    METRIC_REQUEST_COUNTER,
+    METRIC_STAGE_HISTOGRAM,
+    BinderServer,
+)
+from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.utils.probes import ProbeProvider
+from tools.lint import validate_exposition
+
+DOMAIN = "foo.com"
+BALANCER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "mbalancer")
+
+
+def make_fixture():
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "10.0.0.1"}})
+    store.start_session()
+    return cache
+
+
+async def start_server(**kw):
+    """In-process server with a subscribed probe sink; returns
+    (server, events) where events collects (probe name, args)."""
+    provider = ProbeProvider("binder", backend="off")
+    events = []
+    provider.subscribe(lambda name, args: events.append((name, args)))
+    server = BinderServer(zk_cache=kw.pop("zk_cache", None) or
+                          make_fixture(),
+                          dns_domain=DOMAIN, datacenter_name="dc0",
+                          host="127.0.0.1", port=0,
+                          collector=MetricsCollector(),
+                          probes=provider, **kw)
+    await server.start()
+    return server, events
+
+
+async def udp_ask(port, name, qtype, qid=1, rd=False, timeout=5.0):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(make_query(name, qtype, qid=qid,
+                                        rd=rd).encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        data = await asyncio.wait_for(fut, timeout)
+    finally:
+        transport.close()
+    return Message.decode(data)
+
+
+async def tcp_ask(port, name, qtype, qid=2):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    wire = make_query(name, qtype, qid=qid).encode()
+    writer.write(struct.pack(">H", len(wire)) + wire)
+    await writer.drain()
+    (ln,) = struct.unpack(">H", await asyncio.wait_for(
+        reader.readexactly(2), 5))
+    data = await reader.readexactly(ln)
+    writer.close()
+    await writer.wait_closed()
+    return Message.decode(data)
+
+
+def done_events(events):
+    return [args for name, args in events if name == "op-req-done"]
+
+
+class TestTraceIds:
+    def test_thread_concurrent_allocation_unique(self):
+        """8 threads allocating 2000 IDs each never collide (the
+        counter is a single C call; no lock needed or taken)."""
+        per_thread = 2000
+        out = [None] * 8
+
+        def alloc(i):
+            out[i] = [next_trace_id() for _ in range(per_thread)]
+
+        threads = [threading.Thread(target=alloc, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        all_ids = [tid for ids in out for tid in ids]
+        assert len(set(all_ids)) == len(all_ids)
+        # format: "<pid hex>-<seq hex>", distinguishable across the
+        # deployment unit's processes
+        pid_hex = format(os.getpid(), "x")
+        assert all(tid.startswith(pid_hex + "-") for tid in all_ids)
+
+    def test_concurrent_queries_unique_trace_ids(self):
+        """Overlapping in-flight queries each get their own trace ID,
+        and start/done probe events correlate by it."""
+        n = 50
+
+        async def run():
+            server, events = await start_server(query_log=False)
+            try:
+                await asyncio.gather(*[
+                    udp_ask(server.udp_port, "web.foo.com", Type.A,
+                            qid=i + 1) for i in range(n)])
+            finally:
+                await server.stop()
+            return events
+
+        events = asyncio.run(run())
+        starts = [a for nm, a in events if nm == "op-req-start"]
+        dones = done_events(events)
+        assert len(starts) == n and len(dones) == n
+        start_traces = {a["trace"] for a in starts}
+        done_traces = {a["trace"] for a in dones}
+        assert len(start_traces) == n
+        assert start_traces == done_traces
+
+
+class TestPhaseStamps:
+    def assert_stages(self, stages, required):
+        """Required stage names present; every recorded phase >= 0 (the
+        monotonic-clock cursor can never produce a negative delta)."""
+        missing = required - set(stages)
+        assert not missing, f"missing stages {missing} in {stages}"
+        negative = {k: v for k, v in stages.items() if v < 0}
+        assert not negative, f"negative phase durations: {negative}"
+
+    def test_miss_then_hit_stamps(self):
+        async def run():
+            server, events = await start_server(query_log=False)
+            try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A,
+                              qid=1)
+                await udp_ask(server.udp_port, "web.foo.com", Type.A,
+                              qid=2)
+            finally:
+                await server.stop()
+            return events
+
+        dones = done_events(asyncio.run(run()))
+        assert len(dones) == 2
+        # first query: full resolve path through the store
+        self.assert_stages(dones[0]["stages"],
+                           {"store-lookup", "log-after"})
+        # repeat: whole-hit stamp from the answer cache
+        self.assert_stages(dones[1]["stages"],
+                           {"cache-hit", "log-after"})
+        assert "store-lookup" not in dones[1]["stages"]
+
+    def test_tcp_stamps(self):
+        async def run():
+            server, events = await start_server(query_log=False)
+            try:
+                r = await tcp_ask(server.tcp_port, "web.foo.com", Type.A)
+                assert r.answers
+            finally:
+                await server.stop()
+            return events
+
+        dones = done_events(asyncio.run(run()))
+        assert len(dones) == 1
+        self.assert_stages(dones[0]["stages"],
+                           {"store-lookup", "log-after"})
+
+    def test_recursion_fast_path_stamps(self):
+        """The cross-DC forward decomposes into dispatch / upstream RTT
+        / event-loop wait / splice — the split that makes the recursion
+        p50 attributable (the whole await window is also recorded and
+        must cover its two overlay phases)."""
+        from binder_tpu.recursion import Recursion, StaticResolverSource
+
+        async def run():
+            remote_store = FakeStore()
+            remote_cache = MirrorCache(remote_store, DOMAIN)
+            remote_store.put_json("/com/foo/east",
+                                  {"type": "service",
+                                   "service": {"port": 53}})
+            remote_store.put_json(
+                "/com/foo/east/web",
+                {"type": "host", "host": {"address": "10.77.0.1",
+                                          "ttl": 44}})
+            remote_store.start_session()
+            remote = BinderServer(zk_cache=remote_cache,
+                                  dns_domain=DOMAIN,
+                                  datacenter_name="east",
+                                  host="127.0.0.1", port=0,
+                                  collector=MetricsCollector())
+            await remote.start()
+
+            local_store = FakeStore()
+            local_cache = MirrorCache(local_store, DOMAIN)
+            local_store.start_session()
+            recursion = Recursion(
+                zk_cache=local_cache, dns_domain=DOMAIN,
+                datacenter_name="local",
+                source=StaticResolverSource(
+                    {"east": [f"127.0.0.1:{remote.udp_port}"]}),
+                nic_provider=lambda: [])
+            await recursion.wait_ready()
+            server, events = await start_server(
+                zk_cache=local_cache, recursion=recursion,
+                query_log=False)
+            try:
+                # first query cold-starts the pooled upstream port via
+                # the slow coroutine path ("upstream" stamp); the
+                # repeat takes the zero-coroutine fast path whose wait
+                # is split into upstream-rtt + loop-wait
+                for qid in (1, 2):
+                    r = await udp_ask(server.udp_port,
+                                      "web.east.foo.com", Type.A,
+                                      rd=True, qid=qid)
+                    assert r.answers
+            finally:
+                await server.stop()
+                await remote.stop()
+            return events
+
+        dones = done_events(asyncio.run(run()))
+        assert len(dones) == 2
+        self.assert_stages(dones[0]["stages"],
+                           {"store-lookup", "dispatch", "upstream",
+                            "log-after"})
+        stages = dones[1]["stages"]
+        self.assert_stages(stages, {"store-lookup", "dispatch", "await",
+                                    "upstream-rtt", "loop-wait",
+                                    "log-after"})
+        # the response was spliced or rebuilt; either way the local
+        # post-arrival work carries its own stamp
+        assert "splice" in stages or "rebuild" in stages
+
+
+class TestStageMetrics:
+    def test_stage_counts_match_requests_completed(self):
+        """Every after-hook observation lands in BOTH
+        binder_requests_completed and the log-after stage cell, so the
+        two totals must agree exactly for Python-served queries."""
+        n = 7
+
+        async def run():
+            server, _ = await start_server(query_log=False)
+            try:
+                for i in range(n):
+                    # distinct unknown names: no answer-cache reuse, no
+                    # native serving — each traverses the after hook
+                    await udp_ask(server.udp_port, f"m{i}.foo.com",
+                                  Type.A, qid=i + 1)
+            finally:
+                await server.stop()
+            return server
+
+        server = asyncio.run(run())
+        counter = server.collector.get(METRIC_REQUEST_COUNTER)
+        completed = sum(counter._values.values())
+        hist = server.collector.get(METRIC_STAGE_HISTOGRAM)
+        assert completed == n
+        assert hist.count({"stage": "log-after"}) == n
+        # no stage can have observed more queries than completed
+        for key in hist._counts:
+            assert sum(hist._counts[key]) <= completed
+
+    def test_exposition_validates(self):
+        """The full scrape text — counters, gauges, latency/size
+        histograms, and the new per-stage histogram — passes the
+        Prometheus text-format validator (tools/lint.py), so a
+        malformed exposition fails tier-1 here."""
+        async def run():
+            server, _ = await start_server(query_log=False)
+            try:
+                await udp_ask(server.udp_port, "web.foo.com", Type.A)
+                await udp_ask(server.udp_port, "web.foo.com", Type.A,
+                              qid=2)
+                await tcp_ask(server.tcp_port, "web.foo.com", Type.SRV)
+            finally:
+                await server.stop()
+            return server.collector.expose()
+
+        text = asyncio.run(run())
+        assert METRIC_STAGE_HISTOGRAM + "_bucket" in text
+        errors = validate_exposition(text)
+        assert not errors, "\n".join(errors)
+
+    def test_validator_rejects_malformed(self):
+        """The validator itself catches the failure shapes a hand-rolled
+        exposition can produce (guards against a vacuous gate)."""
+        cases = {
+            "no TYPE": 'orphan_metric{a="b"} 1\n',
+            "bad label": '# TYPE m counter\nm{9bad="x"} 1\n',
+            "unquoted": '# TYPE m counter\nm{a=b} 1\n',
+            "bad value": '# TYPE m counter\nm{a="b"} zork\n',
+            "negative counter": '# TYPE m counter\nm -4\n',
+            "duplicate sample": '# TYPE m gauge\nm 1\nm 2\n',
+            "count mismatch": (
+                '# TYPE h histogram\n'
+                'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 5\n'
+                'h_sum 1\nh_count 9\n'),
+            "shrinking buckets": (
+                '# TYPE h histogram\n'
+                'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+                'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n'),
+            "missing +Inf": (
+                '# TYPE h histogram\n'
+                'h_bucket{le="1"} 5\nh_sum 1\nh_count 5\n'),
+            "no final newline": '# TYPE m gauge\nm 1',
+        }
+        for what, text in cases.items():
+            assert validate_exposition(text), f"validator missed: {what}"
+        # and a known-good document yields no findings
+        good = ('# HELP m things\n# TYPE m counter\nm{a="b"} 3\n'
+                '# TYPE h histogram\n'
+                'h_bucket{le="0.5"} 2\nh_bucket{le="+Inf"} 4\n'
+                'h_sum 1.25\nh_count 4\n')
+        assert validate_exposition(good) == []
+
+
+@pytest.mark.skipif(not os.path.exists(BALANCER),
+                    reason="mbalancer not built (make -C native)")
+class TestBalancerStageCounters:
+    def test_stats_socket_stage_cycles_consistent(self):
+        """The stats dump carries the four stage cells, the calibrated
+        TSC rate, and counts consistent with both the balancer's own
+        query counters and the backend's binder_requests_completed."""
+        import tempfile
+        n = 20
+
+        async def run(sockdir):
+            backend = BinderServer(
+                zk_cache=make_fixture(), dns_domain=DOMAIN,
+                datacenter_name="dc0", host="127.0.0.1", port=0,
+                balancer_socket=os.path.join(sockdir, "0"),
+                collector=MetricsCollector(), query_log=False)
+            await backend.start()
+            proc = await asyncio.create_subprocess_exec(
+                BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+                "-s", "150", "-c", "60000",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL)
+            try:
+                line = await asyncio.wait_for(proc.stdout.readline(), 30)
+                assert line.startswith(b"PORT "), line
+                port = int(line.split()[1])
+                await asyncio.sleep(0.5)   # backend scan + connect
+                for i in range(n):
+                    # distinct names: every query is a balancer-cache
+                    # miss, probed and forwarded to the backend
+                    await udp_ask(port, f"c{i}.foo.com", Type.A,
+                                  qid=i + 1)
+                stats = read_stats(sockdir)
+            finally:
+                proc.terminate()
+                await proc.wait()
+                await backend.stop()
+            # scrape AFTER the queries: expose() folds any natively
+            # accumulated backend counts into the collectors
+            backend.collector.expose()
+            counter = backend.collector.get(METRIC_REQUEST_COUNTER)
+            return stats, sum(counter._values.values())
+
+        with tempfile.TemporaryDirectory() as sockdir:
+            stats, backend_completed = asyncio.run(run(sockdir))
+
+        assert stats["udp_queries"] == n
+        # cache on + all misses: every query forwarded, every one
+        # served exactly once by the backend
+        assert backend_completed == n
+        cells = stats["stage_cycles"]
+        assert set(cells) == {"frame-parse", "cache-probe",
+                              "backend-write", "reply-relay"}
+        for name, cell in cells.items():
+            assert cell["cycles"] >= 0 and cell["ops"] >= 0, name
+        # one probe per query (plus response harvests), one write per
+        # forward, one relay per response — none can undercount n
+        assert cells["cache-probe"]["ops"] >= n
+        assert cells["backend-write"]["ops"] >= n
+        assert cells["reply-relay"]["ops"] >= n
+        assert stats["cycles_per_us"] > 0
+        served = stats.get("cache_hits", 0) + \
+            stats.get("cache_misses", 0) + stats.get("uncacheable", 0)
+        assert served == n
+
+
+def read_stats(sockdir):
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.settimeout(2)
+    c.connect(os.path.join(sockdir, ".balancer.stats"))
+    buf = b""
+    while True:
+        chunk = c.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    c.close()
+    return json.loads(buf)

@@ -682,7 +682,7 @@ class TestTcpFrameDeadline:
                     await writer.drain()
                     try:
                         got = await asyncio.wait_for(reader.read(16), 0.25)
-                    except TimeoutError:
+                    except (TimeoutError, asyncio.TimeoutError):
                         continue
                     except (ConnectionResetError, BrokenPipeError):
                         closed_at = asyncio.get_running_loop().time()

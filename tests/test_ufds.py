@@ -68,7 +68,9 @@ class _Pki:
 def tls_pki(tmp_path_factory):
     """CA + server cert for ufds.foo.com/127.0.0.1, plus an unrelated
     'rogue' CA for the negative test."""
-    from cryptography.hazmat.primitives import serialization
+    serialization = pytest.importorskip(
+        "cryptography.hazmat.primitives.serialization",
+        reason="in-test PKI needs the cryptography package")
 
     d = tmp_path_factory.mktemp("ufds-pki")
 

@@ -23,6 +23,7 @@ can be covered).
 """
 import ast
 import os
+import re
 import sys
 
 
@@ -207,6 +208,217 @@ def lint_file(path):
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "syntax-error", e.msg)]
     return Linter(path, tree, source).run()
+
+
+# ---- Prometheus text-exposition validator ----
+#
+# The scrape endpoint (binder_tpu/metrics/collector.py expose()) hand-
+# renders the text format version 0.0.4; a formatting bug there is
+# invisible to every unit test that greps for a substring but breaks
+# real Prometheus ingestion silently.  validate_exposition() checks the
+# whole grammar plus the semantic invariants a hand-rolled histogram
+# can violate: cumulative buckets must be non-decreasing in `le` order,
+# the +Inf bucket must exist and equal `_count`, `_sum`/`_count` must
+# both be present per label set, counters must be finite and
+# non-negative, every sample must belong to a declared # TYPE family,
+# and no (name, labelset) may repeat.  Returns a list of
+# "line N: message" strings; empty list == valid.  Wired into tier-1
+# via tests/test_attribution.py against MetricsCollector.expose().
+
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_label_block(block, errs, lineno):
+    """`k="v",k2="v2"` (no surrounding braces) -> tuple of (k, v) pairs,
+    validating names, quoting, and escape sequences."""
+    pairs = []
+    i, n = 0, len(block)
+    while i < n:
+        j = block.find("=", i)
+        if j < 0:
+            errs.append(f"line {lineno}: malformed label block "
+                        f"{block[i:]!r}")
+            return tuple(pairs)
+        name = block[i:j]
+        if not _LABEL_NAME_RE.match(name):
+            errs.append(f"line {lineno}: bad label name {name!r}")
+        if j + 1 >= n or block[j + 1] != '"':
+            errs.append(f"line {lineno}: label {name!r} value not quoted")
+            return tuple(pairs)
+        k = j + 2
+        val = []
+        while k < n:
+            c = block[k]
+            if c == "\\":
+                if k + 1 >= n or block[k + 1] not in ('\\', '"', 'n'):
+                    errs.append(f"line {lineno}: bad escape in label "
+                                f"{name!r}")
+                    return tuple(pairs)
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[block[k + 1]])
+                k += 2
+            elif c == '"':
+                break
+            else:
+                val.append(c)
+                k += 1
+        else:
+            errs.append(f"line {lineno}: unterminated label value for "
+                        f"{name!r}")
+            return tuple(pairs)
+        pairs.append((name, "".join(val)))
+        i = k + 1
+        if i < n:
+            if block[i] != ",":
+                errs.append(f"line {lineno}: expected ',' between labels")
+                return tuple(pairs)
+            i += 1
+    return tuple(pairs)
+
+
+def _parse_value(tok, errs, lineno, what="value"):
+    if tok in ("+Inf", "-Inf", "Inf", "NaN"):
+        return float(tok.replace("Inf", "inf").replace("NaN", "nan"))
+    try:
+        return float(tok)
+    except ValueError:
+        errs.append(f"line {lineno}: unparseable {what} {tok!r}")
+        return None
+
+
+def validate_exposition(text):
+    """Validate Prometheus text format 0.0.4.  Returns error strings
+    ("line N: msg"); an empty list means the exposition is valid."""
+    errs = []
+    if text and not text.endswith("\n"):
+        errs.append("line 0: exposition must end with a newline")
+    types = {}          # family name -> declared type
+    helps = set()
+    samples = {}        # (sample name, label tuple) -> (lineno, value)
+    family_of = {}      # sample name -> family (for suffix resolution)
+    order = []          # (family, labels-without-le, le, value, lineno)
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line != line.strip():
+            errs.append(f"line {lineno}: leading/trailing whitespace")
+            line = line.strip()
+            if not line:
+                continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 3 or not _METRIC_NAME_RE.match(parts[2]):
+                    errs.append(f"line {lineno}: malformed {parts[1]}")
+                    continue
+                name = parts[2]
+                if parts[1] == "TYPE":
+                    kind = parts[3].strip() if len(parts) > 3 else ""
+                    if kind not in _TYPES:
+                        errs.append(f"line {lineno}: unknown TYPE "
+                                    f"{kind!r} for {name}")
+                    if name in types:
+                        errs.append(f"line {lineno}: duplicate TYPE "
+                                    f"for {name}")
+                    if any(fam == name for fam in family_of.values()):
+                        errs.append(f"line {lineno}: TYPE for {name} "
+                                    "after its samples")
+                    types[name] = kind
+                else:
+                    if name in helps:
+                        errs.append(f"line {lineno}: duplicate HELP "
+                                    f"for {name}")
+                    helps.add(name)
+            continue   # other comments are free-form
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                errs.append(f"line {lineno}: unbalanced braces")
+                continue
+            name = line[:brace]
+            labels = _parse_label_block(line[brace + 1:close], errs,
+                                        lineno)
+            rest = line[close + 1:].split()
+        else:
+            toks = line.split()
+            name, labels, rest = toks[0], (), toks[1:]
+        if not _METRIC_NAME_RE.match(name):
+            errs.append(f"line {lineno}: bad metric name {name!r}")
+            continue
+        if len(rest) not in (1, 2):
+            errs.append(f"line {lineno}: expected 'name value "
+                        "[timestamp]'")
+            continue
+        value = _parse_value(rest[0], errs, lineno)
+        if len(rest) == 2 and _parse_value(
+                rest[1], errs, lineno, "timestamp") is None:
+            continue
+        # resolve the family: histogram/summary samples carry suffixes
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:len(name) - len(suffix)]
+            if name.endswith(suffix) and types.get(base) in (
+                    "histogram", "summary"):
+                family = base
+                break
+        if family not in types:
+            errs.append(f"line {lineno}: sample {name!r} has no "
+                        "preceding # TYPE")
+        family_of[name] = family
+        key = (name, labels)
+        if key in samples:
+            errs.append(f"line {lineno}: duplicate sample {name}"
+                        f"{dict(labels)!r} (first at line "
+                        f"{samples[key][0]})")
+        samples[key] = (lineno, value)
+        kind = types.get(family)
+        if kind == "counter" and value is not None and \
+                not (value >= 0.0 and value == value and
+                     value != float("inf")):
+            errs.append(f"line {lineno}: counter {name} value {rest[0]} "
+                        "not a finite non-negative number")
+        if kind == "histogram" and name.endswith("_bucket"):
+            le = dict(labels).get("le")
+            if le is None:
+                errs.append(f"line {lineno}: histogram bucket without "
+                            "le label")
+            else:
+                bare = tuple(p for p in labels if p[0] != "le")
+                order.append((family, bare, le, value, lineno))
+    # histogram semantics per (family, label set)
+    series = {}
+    for family, bare, le, value, lineno in order:
+        series.setdefault((family, bare), []).append((le, value, lineno))
+    for (family, bare), cells in series.items():
+        prev = None
+        inf_val = None
+        for le, value, lineno in cells:
+            lef = _parse_value(le, errs, lineno, "le bound")
+            if lef is None or value is None:
+                continue
+            if prev is not None and lef <= prev[0]:
+                errs.append(f"line {lineno}: {family} buckets out of "
+                            f"le order ({le!r} after {prev[1]!r})")
+            if prev is not None and value < prev[2]:
+                errs.append(f"line {lineno}: {family} cumulative bucket "
+                            f"count decreases at le={le!r}")
+            prev = (lef, le, value)
+            if lef == float("inf"):
+                inf_val = value
+        if inf_val is None:
+            errs.append(f"{family}{dict(bare)!r}: no le=\"+Inf\" bucket")
+        cnt = samples.get((family + "_count", bare))
+        if cnt is None:
+            errs.append(f"{family}{dict(bare)!r}: missing _count")
+        elif inf_val is not None and cnt[1] != inf_val:
+            errs.append(f"line {cnt[0]}: {family}_count {cnt[1]:g} != "
+                        f"+Inf bucket {inf_val:g}")
+        if (family + "_sum", bare) not in samples:
+            errs.append(f"{family}{dict(bare)!r}: missing _sum")
+    return errs
 
 
 def is_python_script(path):
