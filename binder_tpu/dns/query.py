@@ -159,6 +159,15 @@ class QueryCtx:
     def latency_ms(self) -> float:
         return (time.monotonic() - self.start) * 1000.0
 
+    def last_phase(self) -> Optional[str]:
+        """Name of the most recently recorded phase — the in-flight
+        table's "where is this query right now" column (a query parked
+        between stamps is in whatever follows its last one)."""
+        try:
+            return next(reversed(self.times))
+        except StopIteration:
+            return None
+
     # -- completion --
 
     @property

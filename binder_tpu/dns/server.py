@@ -178,6 +178,16 @@ class DnsServer:
         # the same way, so ordinary store churn drops only the affected
         # balancer entries.
         self.gen_source: Optional[Callable[[], int]] = None
+        # In-flight query table (introspection): queries whose handler
+        # went async — the only ones observable "in flight" from outside
+        # (sync completions never leave the dispatch call).  Keyed by
+        # id(query); values are the live QueryCtx objects, whose trace
+        # ID / phase stamps the status endpoint reads.  The sync hot
+        # path pays nothing.
+        self.inflight: dict = {}
+        # Optional flight recorder (installed by BinderServer): the
+        # engine's error path records resolver-error events on it.
+        self.recorder = None
         self._balancer_writers: dict = {}   # writer -> per-conn write lock
         self._gen_dirty = False
         self._pending_inval: set = set()    # tag wires awaiting broadcast
@@ -213,6 +223,7 @@ class DnsServer:
         if pending is None:
             self._after(query)
             return
+        self.inflight[id(query)] = query
         if pending is HANDLED_ASYNC:
             return    # handler completes (and runs after) via callbacks
         task = asyncio.ensure_future(self._run_async(query, pending))
@@ -228,6 +239,12 @@ class DnsServer:
         self._after(query)
 
     def _on_query_error(self, query: QueryCtx, e: Exception) -> None:
+        self.inflight.pop(id(query), None)
+        if self.recorder is not None:
+            self.recorder.record(
+                "resolver-error", trace=query.trace_id,
+                name=query.name(), qtype=query.qtype_name(),
+                error=f"{type(e).__name__}: {e}")
         if isinstance(e, OSError) and e.errno == errno.EHOSTUNREACH:
             # asymmetric routing — log and carry on (lib/server.js:593-607)
             self.log.error("cannot reply to DNS traffic: "
@@ -246,6 +263,7 @@ class DnsServer:
                 pass
 
     def _after(self, query: QueryCtx) -> None:
+        self.inflight.pop(id(query), None)
         if self.on_after is not None and query.responded:
             try:
                 self.on_after(query)

@@ -17,6 +17,8 @@ import sys
 from typing import Dict
 
 from binder_tpu.config.options import ConfigError, parse_options
+from binder_tpu.introspect import (BalancerStatsFold, FlightRecorder,
+                                   Introspector, LoopLagWatchdog)
 from binder_tpu.metrics.collector import MetricsCollector, MetricsServer
 from binder_tpu.server import BinderServer
 from binder_tpu.store import FakeStore, MirrorCache
@@ -35,12 +37,12 @@ def safe_unlink(path: str, log: logging.Logger) -> None:
 
 
 def make_store(options: Dict[str, object], log: logging.Logger,
-               collector=None):
+               collector=None, recorder=None):
     """Select the coordination-store backend from config."""
     store_cfg = options.get("store") or {}
     backend = store_cfg.get("backend", "zookeeper")
     if backend == "fake":
-        store = FakeStore()
+        store = FakeStore(recorder=recorder)
         fixture = store_cfg.get("fixture")
         if fixture:
             import json
@@ -61,6 +63,7 @@ def make_store(options: Dict[str, object], log: logging.Logger,
             session_timeout_ms=int(store_cfg.get("sessionTimeout", 30000)),
             log=log,
             collector=collector,
+            recorder=recorder,
         )
     raise ConfigError(f"unknown store backend: {backend}")
 
@@ -84,9 +87,12 @@ async def run(options: Dict[str, object]) -> BinderServer:
     metrics.start()
     log.info("metrics server started on port %d", metrics.port)
 
-    store = make_store(options, log, collector=collector)
+    recorder = FlightRecorder(
+        capacity=int(options.get("flightRecorderSize", 512)), log=log)
+    store = make_store(options, log, collector=collector,
+                       recorder=recorder)
     cache = MirrorCache(store, str(options["dnsDomain"]), log=log,
-                        collector=collector)
+                        collector=collector, recorder=recorder)
 
     recursion = None
     if options.get("recursion"):
@@ -141,8 +147,31 @@ async def run(options: Dict[str, object]) -> BinderServer:
                        if "maxTcpConns" in options else None),
         max_tcp_write_buffer=(int(options["maxTcpWriteBuffer"])
                               if "maxTcpWriteBuffer" in options else None),
+        flight_recorder=recorder,
     )
     await server.start()
+
+    # introspection layer: loop-lag watchdog, status endpoint, SIGUSR2
+    # flight-recorder dump, balancer stats fold (docs/observability.md)
+    loop = asyncio.get_running_loop()
+    watchdog = LoopLagWatchdog(collector=collector, recorder=recorder)
+    watchdog.start()
+    introspector = Introspector(server=server, recorder=recorder,
+                                watchdog=watchdog, collector=collector,
+                                name=NAME)
+    introspector.set_loop(loop)
+    metrics.status_source = introspector.snapshot
+    recorder.install_sigusr2(
+        loop, path=options.get("flightRecorderDump"))
+    if balancer_socket:
+        # the balancer serves its stats as a sibling socket in the same
+        # directory (docs/balancer-protocol.md)
+        BalancerStatsFold(collector, os.path.join(
+            os.path.dirname(str(balancer_socket)), ".balancer.stats"),
+            log=log)
+    server.watchdog = watchdog          # keep handles for shutdown /
+    server.introspector = introspector  # debugging sessions
+
     log.info("done with binder init")
     server.metrics = metrics  # keep a handle for shutdown
     return server

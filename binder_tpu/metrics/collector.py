@@ -281,26 +281,52 @@ class MetricsCollector:
 
 class MetricsServer:
     """Threaded HTTP scrape server on service-port+1000
-    (triton-metrics analog)."""
+    (triton-metrics analog).  Besides ``/metrics``, serves the
+    kang-style introspection snapshot on ``/status`` (and the kang
+    alias ``/kang/snapshot``) when ``status_source`` is set to a
+    callable returning a JSON-serializable object — one port covers
+    both the time-series and the state views."""
 
     def __init__(self, collector: MetricsCollector, address: str = "0.0.0.0",
                  port: int = 0) -> None:
         self.collector = collector
+        # set post-construction (the Introspector needs the running
+        # server wired first); consulted per request
+        self.status_source = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path != "/metrics":
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = outer.collector.expose().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+            def _reply(self, body: bytes, ctype: str,
+                       code: int = 200) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                import json as _json
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._reply(outer.collector.expose().encode(),
+                                "text/plain; version=0.0.4")
+                    return
+                if (path in ("/status", "/kang/snapshot")
+                        and outer.status_source is not None):
+                    try:
+                        snap = outer.status_source()
+                        body = _json.dumps(snap, default=str,
+                                           indent=1).encode()
+                    except Exception as e:  # noqa: BLE001 — a snapshot
+                        # bug must answer 500, not hang the scraper
+                        body = _json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode()
+                        self._reply(body, "application/json", 500)
+                        return
+                    self._reply(body, "application/json")
+                    return
+                self.send_response(404)
+                self.end_headers()
 
             def log_message(self, *args):
                 pass

@@ -223,6 +223,13 @@ class DnsClient:
         self._ports[(host, port)] = (loop, proto)
         return proto
 
+    def case_mismatch_drops(self) -> int:
+        """Upstream responses dropped for a mismatched dns0x20 question
+        echo, summed across the pooled ports (peer-health
+        introspection; the per-socket counters live on _PortProto)."""
+        return sum(proto.case_mismatch_drops
+                   for _e_loop, proto in self._ports.values())
+
     def close(self) -> None:
         for (_e_loop, proto) in self._ports.values():
             _close_transport(proto)

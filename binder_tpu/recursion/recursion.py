@@ -125,6 +125,10 @@ class Recursion:
         self.nsc_max = ptr_client or DnsClient(concurrency=PTR_CONCURRENCY)
 
         self.dcs: Dict[str, List[str]] = {}
+        # monotonic instant of the last successful resolver-discovery
+        # pull — peer-health introspection (a stale map past several
+        # REFRESH_INTERVALs means discovery is failing quietly)
+        self.last_refresh_mono: Optional[float] = None
         # set by the owning server (engine._after): enables the
         # zero-coroutine fast path, whose future callback must run the
         # metrics/log after-hook itself
@@ -217,6 +221,26 @@ class Recursion:
                 for ips in dcs.values() for ip in ips}
         self.nsc.prune(keep)
         self.nsc_max.prune(keep)
+        self.last_refresh_mono = time.monotonic()
+
+    def introspect(self) -> dict:
+        """Peer-health section of the status snapshot
+        (binder_tpu/introspect/status.py)."""
+        dcs = {dc: list(ips) for dc, ips in self.dcs.items()}
+        last = self.last_refresh_mono
+        return {
+            "ready": self._ready.is_set(),
+            "region": self.region_name,
+            "datacenters": dcs,
+            "peer_count": sum(len(ips) for ips in dcs.values()),
+            "last_refresh_age_seconds": (
+                None if last is None else time.monotonic() - last),
+            # dropped upstream responses whose dns0x20 question echo
+            # mismatched — sustained growth means a spoofer or an
+            # 0x20-incompatible peer
+            "case_mismatch_drops": (self.nsc.case_mismatch_drops()
+                                    + self.nsc_max.case_mismatch_drops()),
+        }
 
     # -- the resolve path (lib/recursion.js:287-388) --
 

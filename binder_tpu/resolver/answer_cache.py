@@ -143,6 +143,21 @@ class AnswerCache:
         return max(0.0, (self.expiry_s - (time.monotonic() - e[1]))
                    * 1000.0)
 
+    def stats(self) -> dict:
+        """Occupancy + economics for the introspection snapshot
+        (binder_tpu/introspect/status.py `answer_cache` section)."""
+        hits, misses = self.hits, self.misses
+        total = hits + misses
+        return {
+            "size": self.size,
+            "entries": len(self._entries),
+            "hits": hits,
+            "misses": misses,
+            "hit_ratio": (hits / total) if total else 0.0,
+            "invalidations": self.invalidations,
+            "expiry_ms": self.expiry_s * 1000.0,
+        }
+
     def clear(self) -> None:
         self._entries.clear()
         self._by_tag.clear()

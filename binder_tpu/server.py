@@ -153,8 +153,13 @@ class BinderServer:
                  tcp_idle_timeout: Optional[float] = None,
                  max_tcp_conns: Optional[int] = None,
                  max_tcp_write_buffer: Optional[int] = None,
-                 probes: Optional[ProbeProvider] = None) -> None:
+                 probes: Optional[ProbeProvider] = None,
+                 flight_recorder=None) -> None:
         self.log = log or logging.getLogger("binder.server")
+        # introspection flight recorder (binder_tpu/introspect):
+        # slow-query events from the after hook and lane, resolver
+        # errors from the engine's error path
+        self.recorder = flight_recorder
         self.host = host
         self.port = port
         self.dns_domain = dns_domain
@@ -214,6 +219,7 @@ class BinderServer:
                                 max_tcp_write_buffer=max_tcp_write_buffer)
         self.engine.on_query = self._on_query
         self.engine.on_after = self._on_after
+        self.engine.recorder = flight_recorder
         # the engine's cap-refusal log line is rate-limited, so the
         # counter is the only complete record — surface it in the scrape
         self._cap_refusal_child = self.collector.counter(
@@ -1282,6 +1288,11 @@ class BinderServer:
         ch[2].observe(len(wire))
         lat_ms = lat_s * 1000.0
         if lat_ms > SLOW_QUERY_MS:
+            if self.recorder is not None:
+                self.recorder.record(
+                    "slow-query", trace=None, name="(raw-lane)",
+                    qtype=Type.name(qtype), rcode=Rcode.name(rcode),
+                    latency_ms=round(lat_ms, 3), stages={})
             log_event(self.log, logging.WARNING, "DNS query",
                       req_id=(data[0] << 8) | data[1], client=src[0],
                       port=f"{src[1]}/{protocol}", edns=edns,
@@ -1472,6 +1483,12 @@ class BinderServer:
                            for k, v in query.times.items()},
             })
         level = logging.WARNING if lat_ms > SLOW_QUERY_MS else logging.INFO
+        if lat_ms > SLOW_QUERY_MS and self.recorder is not None:
+            self.recorder.record(
+                "slow-query", trace=query.trace_id, name=query.name(),
+                qtype=query.qtype_name(), rcode=Rcode.name(query.rcode()),
+                latency_ms=round(lat_ms, 3),
+                stages={k: round(v, 3) for k, v in query.times.items()})
 
         children = self._children_for(query.qtype())
         children[0].inc()

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from typing import Dict, List, Optional
 
 from binder_tpu.store.interface import StoreClient, Watcher
@@ -210,14 +211,33 @@ class TreeNode:
 class MirrorCache:
     """The ZKCache equivalent: domain-keyed node index + reverse-IP index."""
 
+    #: watch events within one STORM_WINDOW that flag a watch storm
+    #: (a registrar gone wild or an ensemble replaying a large backlog —
+    #: either way the mirror is churning far above steady state and the
+    #: flight recorder should keep the evidence)
+    STORM_THRESHOLD = 500
+    STORM_WINDOW = 1.0
+
     def __init__(self, store: StoreClient, domain: str,
                  log: Optional[logging.Logger] = None,
-                 collector=None) -> None:
+                 collector=None, recorder=None) -> None:
         self.store = store
         self.domain = domain.lower()
         self.log = log or logging.getLogger("binder.cache")
+        self.recorder = recorder
         self.nodes: Dict[str, TreeNode] = {}
         self.rev_lookup: Dict[str, TreeNode] = {}
+        # staleness instrumentation: monotonic instants of the last
+        # applied mutation and the last full rebuild.  While the store
+        # session is down no watch events arrive, so the mutation age
+        # IS the mirror's staleness bound — the quantity the status
+        # endpoint and binder_mirror_staleness_seconds report.
+        self.last_mutation_mono: Optional[float] = None
+        self.last_rebuild_mono: Optional[float] = None
+        # watch-storm window accounting
+        self._storm_window_start = 0.0
+        self._storm_count = 0
+        self._storm_flagged = False
         # generation counter: bumped on every mirrored mutation; drives
         # the balancer's generation broadcast (its cache entries are
         # validated against the backend's advertised gen)
@@ -270,6 +290,11 @@ class MirrorCache:
                 "binder_store_ready",
                 "1 when the mirror has a live session and root node"
             ).set_function(lambda: 1.0 if self.is_ready() else 0.0)
+            collector.gauge(
+                "binder_mirror_staleness_seconds",
+                "age of the last change applied to the store mirror "
+                "(bounds answer staleness while the session is down)"
+            ).set_function(lambda: self.staleness_seconds() or 0.0)
         store.on_session(self.rebuild)
 
     def on_mutation(self, cb) -> None:
@@ -293,6 +318,22 @@ class MirrorCache:
 
     def bump_gen(self) -> None:
         self.gen += 1
+        now = time.monotonic()
+        self.last_mutation_mono = now
+        if self.recorder is not None:
+            # watch-storm detection: count mutations per fixed window,
+            # flag once per window when the threshold is crossed
+            if now - self._storm_window_start > self.STORM_WINDOW:
+                self._storm_window_start = now
+                self._storm_count = 0
+                self._storm_flagged = False
+            self._storm_count += 1
+            if (self._storm_count >= self.STORM_THRESHOLD
+                    and not self._storm_flagged):
+                self._storm_flagged = True
+                self.recorder.record(
+                    "watch-storm", events=self._storm_count,
+                    window_s=self.STORM_WINDOW, generation=self.gen)
         for cb in self._mutation_cbs:
             try:
                 cb()
@@ -301,6 +342,21 @@ class MirrorCache:
 
     def is_ready(self) -> bool:
         return self.domain in self.nodes
+
+    def staleness_seconds(self) -> Optional[float]:
+        """Age of the last applied change (mutation or full rebuild).
+
+        While the store session is live this is ordinary quiet time;
+        with the session down it bounds how old the mirror's answers
+        may be — the "silent aging" quantity a pure query-side view
+        cannot see.  None when nothing was ever mirrored."""
+        last = self.last_mutation_mono
+        if last is None or (self.last_rebuild_mono is not None
+                            and self.last_rebuild_mono > last):
+            last = self.last_rebuild_mono
+        if last is None:
+            return None
+        return time.monotonic() - last
 
     def lookup(self, domain: str) -> Optional[TreeNode]:
         return self.nodes.get(domain)
@@ -330,6 +386,10 @@ class MirrorCache:
         (lib/zk.js:68-76)."""
         if self.m_rebuilds is not None:
             self.m_rebuilds.inc()
+        self.last_rebuild_mono = time.monotonic()
+        if self.recorder is not None:
+            self.recorder.record("mirror-rebuild", epoch=self.epoch + 1,
+                                 nodes=len(self.nodes))
         # a (re)session may deliver arbitrary unseen changes while the
         # subtree re-syncs: conservatively invalidate every cached answer
         self.epoch += 1

@@ -19,7 +19,8 @@ from __future__ import annotations
 import json
 from typing import Callable, Dict, List, Optional, Tuple
 
-from binder_tpu.store.interface import StoreClient, Watcher
+from binder_tpu.store.interface import (SessionStateMixin, StoreClient,
+                                        Watcher)
 
 
 class _Node:
@@ -30,8 +31,9 @@ class _Node:
         self.children: Dict[str, _Node] = {}
 
 
-class FakeStore(StoreClient):
-    def __init__(self) -> None:
+class FakeStore(SessionStateMixin, StoreClient):
+    def __init__(self, recorder=None) -> None:
+        self._init_session_state(recorder)
         self._root = _Node()
         self._watchers: Dict[str, Watcher] = {}
         self._session_cbs: List[Callable[[], None]] = []
@@ -55,19 +57,29 @@ class FakeStore(StoreClient):
         return self._connected
 
     def close(self) -> None:
+        self._session_transition("closed", "close() called")
         self._connected = False
 
     # -- session simulation --
 
     def start_session(self) -> None:
         self._connected = True
+        self._session_transition("connected", "start_session")
         for cb in list(self._session_cbs):
             cb()
 
     def expire_session(self) -> None:
         """Session loss immediately followed by a new session."""
         self._connected = False
+        self._session_transition("expired", "expire_session")
         self.start_session()
+
+    def lose_session(self) -> None:
+        """Session loss with NO re-establishment: the store goes dark
+        and the mirror starts aging — the silent staleness failure the
+        introspection layer exists to surface."""
+        self._connected = False
+        self._session_transition("degraded", "lose_session")
 
     # -- tree access --
 

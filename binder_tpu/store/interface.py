@@ -25,7 +25,79 @@ Semantics modeled on zkstream's surface as consumed by the cache:
 """
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Callable, Dict, List
+
+#: Session states shared by every StoreClient implementation.  The
+#: distinction between "never-connected" and "degraded" is the one the
+#: plain is_connected() bool could not express: a binder that has not
+#: yet reached its ensemble serves nothing, while one whose session was
+#: lost keeps serving an aging mirror — operationally very different
+#: failures (the second is the silent one the introspection layer
+#: exists to surface).
+SESSION_STATES = ("never-connected", "connected", "degraded", "expired",
+                  "closed")
+
+
+class SessionStateMixin:
+    """Session state machine + transition history for store clients.
+
+    Tracks the exact monotonic timestamp of every state transition so
+    ``disconnected_seconds()`` is measured, never inferred, and keeps a
+    bounded transition history (the reconnect/backoff record served by
+    the introspection snapshot).  An optional flight recorder receives
+    a ``session-transition`` event per edge."""
+
+    def _init_session_state(self, recorder=None, history: int = 64) -> None:
+        self._session_state = "never-connected"
+        self._state_since = time.monotonic()
+        # monotonic instant the session was lost (set on leaving
+        # "connected", cleared on re-entering it); None while connected
+        # or never connected
+        self._disconnected_since = None
+        self.session_establishments = 0
+        self._transitions = deque(maxlen=history)
+        self._session_recorder = recorder
+
+    def _session_transition(self, new: str, reason: str = "") -> None:
+        old = self._session_state
+        if new == old:
+            return
+        now = time.monotonic()
+        self._session_state = new
+        self._state_since = now
+        if new == "connected":
+            self._disconnected_since = None
+            self.session_establishments += 1
+        elif old == "connected":
+            self._disconnected_since = now
+        self._transitions.append({
+            "t_mono": now, "t_wall": time.time(),
+            "from": old, "to": new, "reason": reason,
+        })
+        rec = self._session_recorder
+        if rec is not None:
+            rec.record("session-transition", frm=old, to=new,
+                       reason=reason)
+
+    def session_state(self) -> str:
+        return self._session_state
+
+    def disconnected_seconds(self):
+        """Exact seconds since the session was lost: 0.0 while
+        connected, None when no session was ever established (there is
+        no loss instant to measure from), else the measured age of the
+        connected→lost transition."""
+        if self._session_state == "connected":
+            return 0.0
+        if self._disconnected_since is None:
+            return None
+        return time.monotonic() - self._disconnected_since
+
+    def session_transitions(self) -> List[dict]:
+        """Bounded transition history, oldest first."""
+        return list(self._transitions)
 
 
 class Watcher:

@@ -32,7 +32,8 @@ import struct
 from typing import Callable, Dict, List, Optional
 
 from binder_tpu.store import jute
-from binder_tpu.store.interface import StoreClient, Watcher
+from binder_tpu.store.interface import (SessionStateMixin, StoreClient,
+                                        Watcher)
 from binder_tpu.store.jute import Buf, Err, EventType, OpCode
 from binder_tpu.utils.endpoints import parse_endpoint
 
@@ -70,11 +71,12 @@ def parse_connect_string(address: str, default_port: int
     return servers
 
 
-class ZKClient(StoreClient):
+class ZKClient(SessionStateMixin, StoreClient):
     def __init__(self, address: str = "127.0.0.1", port: int = 2181,
                  session_timeout_ms: int = 30000,
                  log: Optional[logging.Logger] = None,
-                 collector=None) -> None:
+                 collector=None, recorder=None) -> None:
+        self._init_session_state(recorder)
         self.address = address
         self.port = port
         # ensemble rotation state: reconnects walk the server list round-
@@ -148,9 +150,15 @@ class ZKClient(StoreClient):
         return w
 
     def is_connected(self) -> bool:
+        """True only while a live session is established.  The bool
+        cannot distinguish "never connected" from "session lost" — use
+        ``session_state()`` (SessionStateMixin) for the full state
+        machine and ``disconnected_seconds()`` for the exact, measured
+        age of a loss."""
         return self._connected
 
     def close(self) -> None:
+        self._session_transition("closed", "close() called")
         self._closed = True
         self._connected = False
         if self._writer is not None:
@@ -166,13 +174,19 @@ class ZKClient(StoreClient):
 
     async def _session_loop(self) -> None:
         while not self._closed:
+            err = ""
             try:
                 await self._run_session()
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001
                 self.log.warning("zk: session error: %s", e)
+                err = str(e)
             self._connected = False
+            if self._session_state == "connected":
+                # a live session just dropped: degraded until the
+                # reconnect either resumes it or learns it expired
+                self._session_transition("degraded", err or "disconnected")
             # whatever ended the session, try the next ensemble member
             # (reconnecting straight back to a dead server would burn a
             # full RECONNECT_DELAY cycle per retry)
@@ -220,6 +234,8 @@ class ZKClient(StoreClient):
             if timeout <= 0 or session_id == 0:
                 # session expired server-side: start a fresh one
                 self.log.warning("zk: session expired; starting new session")
+                self._session_transition("expired",
+                                         "session expired server-side")
                 self._session_id = 0
                 self._passwd = b"\x00" * 16
                 return
@@ -227,6 +243,8 @@ class ZKClient(StoreClient):
             self._passwd = passwd
             self._negotiated_timeout = timeout
             self._connected = True
+            self._session_transition(
+                "connected", f"session 0x{session_id:x} via {host}:{port}")
             if self.m_sessions is not None:
                 self.m_sessions.inc()
             self.log.info("zk: session 0x%x established (timeout %dms)",

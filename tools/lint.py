@@ -421,6 +421,138 @@ def validate_exposition(text):
     return errs
 
 
+# ---- introspection snapshot-schema validator ----
+#
+# The /status endpoint (binder_tpu/introspect/status.py) is consumed by
+# bin/bstat and by operators' jq one-liners; a silently dropped or
+# retyped field breaks both without failing any substring-grepping
+# test.  validate_status_snapshot() pins the schema: required sections,
+# required keys per section, and value types (None allowed only where
+# the schema says nullable).  Returns "path: message" strings; empty
+# list == valid.  Wired into tier-1 via tests/test_introspect.py
+# against a live HTTP endpoint, and into `make status-smoke`.
+
+_NUM = (int, float)
+# section -> {key: (types, nullable)}
+_SNAPSHOT_SCHEMA = {
+    "service": {
+        "name": (str, False), "pid": (int, False),
+        "version": (int, False), "uptime_seconds": (_NUM, False),
+        "generated_at": (_NUM, False),
+    },
+    "store": {
+        "backend": (str, True), "state": (str, False),
+        "connected": (bool, False),
+        "disconnected_seconds": (_NUM, True),
+        "session_establishments": (int, False),
+        "transitions": (list, False),
+    },
+    "mirror": {
+        "ready": (bool, False), "domain": (str, True),
+        "generation": (int, False), "epoch": (int, False),
+        "nodes": (int, False), "reverse_entries": (int, False),
+        "staleness_seconds": (_NUM, True),
+        "last_rebuild_age_seconds": (_NUM, True),
+    },
+    "answer_cache": {
+        "size": (int, False), "entries": (int, False),
+        "hits": (int, False), "misses": (int, False),
+        "hit_ratio": (_NUM, False), "invalidations": (int, False),
+        "expiry_ms": (_NUM, False),
+    },
+    "inflight": {
+        "count": (int, False), "queries": (list, False),
+    },
+}
+_SESSION_STATES = ("never-connected", "connected", "degraded", "expired",
+                   "closed")
+_INFLIGHT_KEYS = ("trace", "name", "type", "client", "protocol",
+                  "age_ms", "phase", "phases")
+_TRANSITION_KEYS = ("t_wall", "age_seconds", "from", "to", "reason")
+
+
+def _check_keys(obj, schema, where, errs):
+    for key, (types, nullable) in schema.items():
+        if key not in obj:
+            errs.append(f"{where}: missing key {key!r}")
+            continue
+        val = obj[key]
+        if val is None:
+            if not nullable:
+                errs.append(f"{where}.{key}: null not allowed")
+            continue
+        if not isinstance(val, types):
+            errs.append(f"{where}.{key}: expected "
+                        f"{getattr(types, '__name__', types)}, got "
+                        f"{type(val).__name__}")
+
+
+def validate_status_snapshot(snap):
+    """Validate an introspection snapshot (parsed JSON).  Returns error
+    strings; an empty list means the snapshot is schema-complete."""
+    errs = []
+    if not isinstance(snap, dict):
+        return [f"snapshot: expected object, got {type(snap).__name__}"]
+    for section, schema in _SNAPSHOT_SCHEMA.items():
+        sub = snap.get(section)
+        if not isinstance(sub, dict):
+            errs.append(f"{section}: missing or not an object")
+            continue
+        _check_keys(sub, schema, section, errs)
+    # nullable top-level sections must still be PRESENT (consumers key
+    # on them to know the feature is off, not mistyped)
+    for section in ("recursion", "loop", "flight_recorder"):
+        if section not in snap:
+            errs.append(f"{section}: key must be present (null when "
+                        "the subsystem is off)")
+        elif snap[section] is not None and not isinstance(
+                snap[section], dict):
+            errs.append(f"{section}: expected object or null")
+    store = snap.get("store")
+    if isinstance(store, dict):
+        if store.get("state") not in _SESSION_STATES:
+            errs.append(f"store.state: unknown state "
+                        f"{store.get('state')!r}")
+        for i, tr in enumerate(store.get("transitions") or []):
+            if not isinstance(tr, dict):
+                errs.append(f"store.transitions[{i}]: not an object")
+                continue
+            for key in _TRANSITION_KEYS:
+                if key not in tr:
+                    errs.append(f"store.transitions[{i}]: missing "
+                                f"{key!r}")
+    infl = snap.get("inflight")
+    if isinstance(infl, dict) and isinstance(infl.get("queries"), list):
+        if infl.get("count") != len(infl["queries"]):
+            errs.append("inflight.count != len(inflight.queries)")
+        for i, q in enumerate(infl["queries"]):
+            if not isinstance(q, dict):
+                errs.append(f"inflight.queries[{i}]: not an object")
+                continue
+            for key in _INFLIGHT_KEYS:
+                if key not in q:
+                    errs.append(f"inflight.queries[{i}]: missing "
+                                f"{key!r}")
+    loop = snap.get("loop")
+    if isinstance(loop, dict):
+        for key in ("interval_seconds", "stall_threshold_seconds",
+                    "samples", "stalls", "last_lag_seconds",
+                    "max_lag_seconds"):
+            if key not in loop:
+                errs.append(f"loop: missing {key!r}")
+    fr = snap.get("flight_recorder")
+    if isinstance(fr, dict):
+        for key in ("capacity", "recorded", "dropped", "by_type",
+                    "events"):
+            if key not in fr:
+                errs.append(f"flight_recorder: missing {key!r}")
+        seqs = [ev.get("seq") for ev in fr.get("events") or []
+                if isinstance(ev, dict)]
+        if seqs != sorted(seqs):
+            errs.append("flight_recorder.events: seq not ascending")
+    return errs
+
+
 def is_python_script(path):
     if path.endswith(".py"):
         return True

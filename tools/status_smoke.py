@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""status-smoke: boot a fake-store binder, fetch /status, validate, exit.
+
+The CI-sized proof that the introspection layer works end to end over
+real HTTP: a server on an ephemeral port with the fake store, one
+resolved query (so the snapshot carries non-trivial cache state), a
+scrape-thread fetch of ``/status``, the snapshot-schema validator from
+``tools/lint.py``, and a ``/metrics`` fetch through the Prometheus
+exposition validator (the introspection gauges must not break the
+scrape).  Exit 0 == both validators clean.  Run via `make status-smoke`.
+"""
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.introspect import (FlightRecorder, Introspector,  # noqa: E402
+                                   LoopLagWatchdog)
+from binder_tpu.metrics.collector import (MetricsCollector,  # noqa: E402
+                                          MetricsServer)
+from binder_tpu.server import BinderServer  # noqa: E402
+from binder_tpu.store import FakeStore, MirrorCache  # noqa: E402
+from tools.lint import (validate_exposition,  # noqa: E402
+                        validate_status_snapshot)
+
+DOMAIN = "foo.com"
+
+
+async def run() -> int:
+    recorder = FlightRecorder()
+    collector = MetricsCollector()
+    store = FakeStore(recorder=recorder)
+    cache = MirrorCache(store, DOMAIN, collector=collector,
+                        recorder=recorder)
+    store.put_json("/com/foo/web",
+                   {"type": "host", "host": {"address": "10.0.0.1"}})
+    store.start_session()
+
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="dc0", host="127.0.0.1",
+                          port=0, collector=collector, query_log=False,
+                          flight_recorder=recorder)
+    await server.start()
+    watchdog = LoopLagWatchdog(collector=collector, recorder=recorder,
+                               interval=0.02)
+    watchdog.start()
+    intro = Introspector(server=server, recorder=recorder,
+                         watchdog=watchdog, collector=collector)
+    intro.set_loop(asyncio.get_running_loop())
+    metrics = MetricsServer(collector, address="127.0.0.1", port=0)
+    metrics.status_source = intro.snapshot
+    metrics.start()
+
+    # one real query so the snapshot reflects serve-path state
+    from binder_tpu.dns import Type, make_query
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(make_query(f"web.{DOMAIN}", Type.A,
+                                        qid=7).encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", server.udp_port))
+    await asyncio.wait_for(fut, 5)
+    transport.close()
+    await asyncio.sleep(0.1)   # a couple of watchdog samples
+
+    rc = 0
+    url = f"http://127.0.0.1:{metrics.port}"
+    snap = await asyncio.to_thread(lambda: json.loads(
+        urllib.request.urlopen(f"{url}/status", timeout=5).read()))
+    errs = validate_status_snapshot(snap)
+    for e in errs:
+        print(f"status-smoke: snapshot: {e}", file=sys.stderr)
+    rc |= 1 if errs else 0
+
+    text = await asyncio.to_thread(lambda: urllib.request.urlopen(
+        f"{url}/metrics", timeout=5).read().decode())
+    for metric in ("binder_zk_session_state", "binder_loop_lag_seconds",
+                   "binder_mirror_staleness_seconds",
+                   "binder_inflight_queries"):
+        if metric not in text:
+            print(f"status-smoke: scrape missing {metric}",
+                  file=sys.stderr)
+            rc |= 1
+    errs = validate_exposition(text)
+    for e in errs:
+        print(f"status-smoke: exposition: {e}", file=sys.stderr)
+    rc |= 1 if errs else 0
+
+    watchdog.stop()
+    await server.stop()
+    metrics.stop()
+    if rc == 0:
+        print(f"status-smoke: ok (store={snap['store']['state']}, "
+              f"mirror nodes={snap['mirror']['nodes']}, "
+              f"loop samples={snap['loop']['samples']})")
+    return rc
+
+
+def main() -> int:
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())
