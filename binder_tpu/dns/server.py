@@ -632,6 +632,18 @@ class DnsServer:
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
             return
+        # TCP_NODELAY, explicitly: a DNS response is one small framed
+        # write, exactly the shape Nagle + delayed ACK turn into 40ms
+        # stalls (the loadgen sets it client-side already).  asyncio's
+        # selector transports set it by default, but that is an
+        # implementation detail of one event-loop family — the serving
+        # contract is pinned here, for every loop.
+        tsock = writer.get_extra_info("socket")
+        if tsock is not None:
+            try:
+                tsock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         self._conns.add(writer)
         self._tcp_conns.add(writer)
 

@@ -133,10 +133,19 @@ class Introspector:
             "answer_cache": self._cache_section(),
             "inflight": self._inflight_section(),
             "recursion": self._recursion_section(),
+            "precompile": self._precompile_section(),
             "loop": (self.watchdog.snapshot()
                      if self.watchdog is not None else None),
             "flight_recorder": self._recorder_section(),
         }
+
+    def _precompile_section(self) -> Optional[dict]:
+        """Mutation-time precompiler state (null when the feature is
+        off): queue depth vs its bound is the backlog signal the
+        operations runbook keys on."""
+        pc = getattr(self.server, "_precompiler", None) \
+            if self.server is not None else None
+        return None if pc is None else pc.introspect()
 
     def _store_section(self) -> dict:
         st = self.store
@@ -189,7 +198,9 @@ class Introspector:
         if self.server is None:
             return {"size": 0, "entries": 0, "hits": 0, "misses": 0,
                     "hit_ratio": 0.0, "invalidations": 0,
-                    "expiry_ms": 0.0}
+                    "expiry_ms": 0.0, "neg_hits": 0,
+                    "compiled_entries": 0, "compiled_serves": 0,
+                    "compiled_installs": 0}
         return self.server.answer_cache.stats()
 
     def _inflight_section(self) -> dict:

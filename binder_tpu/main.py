@@ -141,6 +141,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
         cache_size=int(options.get("size", 10000)),
         cache_expiry_ms=int(options.get("expiry", 60000)),
         zone_precompile=bool(options.get("zonePrecompile", True)),
+        answer_precompile=bool(options.get("answerPrecompile", True)),
+        precompile_size=(int(options["precompileSize"])
+                         if "precompileSize" in options else None),
         tcp_idle_timeout=(float(options["tcpIdleTimeout"])
                           if "tcpIdleTimeout" in options else None),
         max_tcp_conns=(int(options["maxTcpConns"])

@@ -79,6 +79,54 @@ def _valid_record(record) -> bool:
             and isinstance(record.get(record["type"]), dict))
 
 
+class AnswerPlan:
+    """Outcome of PURE resolution for one question — no transport, no
+    RD/EDNS posture, no QueryCtx.  The plan/render split exists so the
+    same resolution logic serves two callers:
+
+    - the query path (``Resolver.resolve``/``resolve_ptr``): plan, then
+      apply to the live QueryCtx (shuffle rotatable groups, respond);
+    - the mutation-time precompiler (``resolver/precompile.py``): plan
+      once per affected name when the mirror changes, render every
+      rotation variant to wire, and install the finished answers so
+      post-churn queries never pay a resolve.
+
+    ``groups`` is the rotation unit list: each element is
+    ``(answers, additionals)`` for one service member (or the single
+    answer for non-service shapes).  The query path shuffles groups
+    (round-robin); the precompiler renders cyclic rotations of them.
+
+    Known deviation from the pre-split engine: a service with an
+    invalid member record still answers SERVFAIL, but with an empty
+    answer section (the old code kept the members it had already
+    shuffled past — answer content on SERVFAIL is not load-bearing and
+    SERVFAIL is never cached).
+    """
+
+    __slots__ = ("rcode", "groups", "authorities", "rotatable",
+                 "dep_domain", "miss", "reason", "log_query")
+
+    def __init__(self) -> None:
+        self.rcode = Rcode.NOERROR
+        self.groups: list = []        # [(answers, additionals)] per unit
+        self.authorities: list = []
+        self.rotatable = False
+        self.dep_domain: Optional[str] = None
+        #: the mirror had no node for the name — the recursion-candidate
+        #: shape (rcode is REFUSED; the query path may forward instead)
+        self.miss = False
+        self.reason: Optional[str] = None      # log_ctx["reason"]
+        self.log_query: Optional[dict] = None  # log_ctx["query"]
+
+    @property
+    def negative(self) -> bool:
+        """NXDOMAIN or NODATA (NOERROR with an empty answer section) —
+        the shapes the answer cache accounts separately (and SERVFAIL
+        is never cached at all)."""
+        return (self.rcode == Rcode.NXDOMAIN
+                or (self.rcode == Rcode.NOERROR and not self.groups))
+
+
 class Resolver:
     """Stateless resolution engine over a mirror cache (+ optional
     recursion)."""
@@ -113,92 +161,88 @@ class Resolver:
         return None
 
     # -- forward resolution (lib/server.js:136-429) --
+    #
+    # resolve() = plan() + apply: plan is the PURE resolution (also the
+    # mutation-time precompiler's entry point); apply handles the live
+    # query's concerns — log context, attribution stamps, the recursion
+    # handoff (RD-dependent, so it cannot live in the plan), round-robin
+    # shuffle, and the respond.
 
     def resolve(self, query: QueryCtx):
-        domain = query.name()
+        plan = self.plan(query.name(), query.qtype())
+        return self._finish(query, plan)
+
+    def plan(self, qname: str, qtype: int) -> AnswerPlan:
+        """Pure resolution of an A/SRV question against the mirror."""
+        p = AnswerPlan()
+        domain = qname
 
         service = protocol = None
-        m = SRV_RE.match(domain)
-        if query.qtype() == Type.SRV:
+        if qtype == Type.SRV:
+            m = SRV_RE.match(domain)
             if not m or len(m.group(3)) < 1:
-                query.log_ctx["reason"] = "not a valid SRV lookup domain"
-                query.set_error(Rcode.REFUSED)
-                query.respond()
-                return
+                p.reason = "not a valid SRV lookup domain"
+                p.rcode = Rcode.REFUSED
+                return p
             service, protocol, domain = m.group(1), m.group(2), m.group(3)
 
         if self.dns_domain:
             if _is_suffix("." + self.dns_domain, domain):
                 stripped = domain[:-(len(self.dns_domain) + 1)]
             else:
-                query.log_ctx["reason"] = "not within dns domain suffix"
-                query.set_error(Rcode.REFUSED)
-                query.respond()
-                return
+                p.reason = "not within dns domain suffix"
+                p.rcode = Rcode.REFUSED
+                return p
             dcsuff = self.dns_domain + "." + self.datacenter_name
             if (stripped == self.dns_domain
                     or _is_suffix("." + self.dns_domain, stripped)
                     or stripped == dcsuff
                     or _is_suffix("." + dcsuff, stripped)):
-                query.log_ctx["reason"] = "doubled-up dns domain suffix"
-                query.set_error(Rcode.REFUSED)
-                query.respond()
-                return
+                p.reason = "doubled-up dns domain suffix"
+                p.rcode = Rcode.REFUSED
+                return p
 
-        query.log_ctx["query"] = {
+        p.log_query = {
             "srv": f"{service}.{protocol}" if service else None,
             "name": domain,
-            "type": query.qtype_name(),
+            "type": Type.name(qtype),
         }
 
         if not self.cache.is_ready():
             self.log.error("no coordination-store session")
-            query.set_error(Rcode.SERVFAIL)
-            query.respond()
-            return
+            p.rcode = Rcode.SERVFAIL
+            return p
 
         if len(domain) < 1:
-            query.set_error(Rcode.REFUSED)
-            query.respond()
-            return
+            p.rcode = Rcode.REFUSED
+            return p
 
         domain = domain.lower()
         if NAME_RE.search(domain):
-            query.log_ctx["reason"] = "invalid name"
-            query.set_error(Rcode.REFUSED)
-            query.respond()
-            return
+            p.reason = "invalid name"
+            p.rcode = Rcode.REFUSED
+            return p
 
         # dependency tag for the answer caches: whatever this lookup
         # yields (including a miss-REFUSED) changes when `domain`
         # mutates in the store — note for SRV this is the *service node*
         # domain, not the _svc._proto-prefixed qname
-        query.dep_domain = domain
-        # traced: stamps "store-lookup" (decode→policy→mirror probe) on
-        # the query's attribution timeline
-        node = self.cache.lookup_traced(domain, query)
+        p.dep_domain = domain
+        node = self.cache.lookup(domain)
 
         if node is None:
-            if self.recursion is not None and query.rd():
-                # recursion answers belong to another DC's store — no
-                # cache layer may keep them (query.no_store reaches the
-                # balancer as the do-not-store transport marker)
-                query.no_store = True
-                return self.recursion.resolve(query)
             # REFUSED, not NXDOMAIN: clients must fail over to their next
-            # nameserver (lib/server.js:227-241)
-            query.set_error(Rcode.REFUSED)
-            query.stamp("pre-resp")
-            query.respond()
-            return
+            # nameserver (lib/server.js:227-241).  The query path may
+            # forward to recursion instead (RD-dependent, see _finish).
+            p.miss = True
+            p.rcode = Rcode.REFUSED
+            return p
 
         record = node.data
         if not _valid_record(record):
             self.log.error("invalid store record at %s: %r", domain, record)
-            query.set_error(Rcode.SERVFAIL)
-            query.stamp("pre-resp")
-            query.respond()
-            return
+            p.rcode = Rcode.SERVFAIL
+            return p
 
         sub = record[record["type"]]
         ttl = _record_ttl(record, sub)
@@ -206,33 +250,29 @@ class Resolver:
         if service is not None and record["type"] != "service":
             # SRV on a non-service name we own: NODATA + SOA for negative
             # caching (lib/server.js:276-292)
-            query.set_error(Rcode.NOERROR)
-            query.add_authority(SOARecord(
+            p.authorities.append(SOARecord(
                 name=domain, ttl=ttl, mname=self.dns_domain, minimum=ttl))
-            query.stamp("build_response")
-            query.respond()
-            return
+            return p
 
         rtype = record["type"]
         if rtype == "database":
             addr = urlparse(sub.get("primary", "")).hostname
-            query.add_answer(ARecord(name=domain, ttl=ttl, address=addr))
+            p.groups.append(([ARecord(name=domain, ttl=ttl, address=addr)],
+                             []))
         elif rtype in ("db_host", "host", "load_balancer", "moray_host",
                        "redis_host", "ops_host", "rr_host"):
-            query.add_answer(ARecord(name=domain, ttl=ttl,
-                                     address=sub.get("address")))
+            p.groups.append(([ARecord(name=domain, ttl=ttl,
+                                      address=sub.get("address"))], []))
         elif rtype == "service":
-            self._resolve_service(query, node, record, domain,
-                                  service, protocol, ttl)
+            self._plan_service(p, node, record, qname, domain,
+                               service, protocol, ttl)
         else:
             self.log.error("record type %r in store is unknown", rtype)
+        return p
 
-        query.stamp("pre-resp")
-        query.respond()
-
-    def _resolve_service(self, query: QueryCtx, node, record: dict,
-                         domain: str, service: Optional[str],
-                         protocol: Optional[str], ttl: int) -> None:
+    def _plan_service(self, p: AnswerPlan, node, record: dict, qname: str,
+                      domain: str, service: Optional[str],
+                      protocol: Optional[str], ttl: int) -> None:
         s = record["service"]
         if isinstance(s.get("service"), dict):
             # nested historical format; TTL may live here too
@@ -244,24 +284,24 @@ class Resolver:
                                     or protocol != s.get("proto")):
             # SRV for a service/proto that doesn't match the registered
             # one: we own the name, so NXDOMAIN (lib/server.js:334-345)
-            query.set_error(Rcode.NXDOMAIN)
+            p.rcode = Rcode.NXDOMAIN
             return
 
         # explicit NOERROR so an empty service doesn't fall through
         # (lib/server.js:347-351)
-        query.set_error(Rcode.NOERROR)
+        p.rcode = Rcode.NOERROR
 
         kids = [k for k in node.children
                 if isinstance(k.data, dict)
                 and k.data.get("type") in SERVICE_CHILD_TYPES]
-        self.rng.shuffle(kids)
 
         for knode in kids:
             krec = knode.data
             if not _valid_record(krec):
-                query.set_error(Rcode.SERVFAIL)
+                p.rcode = Rcode.SERVFAIL
+                p.groups = []
                 self.log.error("bad store info under %s", domain)
-                break
+                return
             ksub = krec[krec["type"]]
             addr = ksub.get("address")
             if addr is None:
@@ -273,28 +313,66 @@ class Resolver:
 
             if service is not None:
                 nm = f"{knode.name}.{domain}"
-                for p in ports:
-                    query.add_answer(SRVRecord(
-                        name=query.name(), ttl=ttl, priority=0, weight=10,
-                        port=p, target=nm))
-                query.add_additional(ARecord(name=nm, ttl=rttl, address=addr))
+                answers = [SRVRecord(
+                    name=qname, ttl=ttl, priority=0, weight=10,
+                    port=prt, target=nm) for prt in ports]
+                p.groups.append(
+                    (answers, [ARecord(name=nm, ttl=rttl, address=addr)]))
             else:
                 # plain A for a service: membership AND address — use the
                 # smaller of the two TTLs (lib/server.js:403-414)
-                query.add_answer(ARecord(name=domain, ttl=min(ttl, rttl),
-                                         address=addr))
+                p.groups.append(([ARecord(name=domain, ttl=min(ttl, rttl),
+                                          address=addr)], []))
+        p.rotatable = len(p.groups) > 1
+
+    def _finish(self, query: QueryCtx, plan: AnswerPlan):
+        """Apply a plan to a live query: log context, the store-lookup
+        attribution stamp, the RD-dependent recursion handoff, group
+        shuffle (round-robin), and the respond."""
+        if plan.log_query is not None:
+            query.log_ctx["query"] = plan.log_query
+        if plan.reason is not None:
+            query.log_ctx["reason"] = plan.reason
+        if plan.dep_domain is not None:
+            query.dep_domain = plan.dep_domain
+        # decode→policy→mirror probe→plan, on the attribution timeline
+        query.stamp("store-lookup")
+        if plan.miss and self.recursion is not None and query.rd():
+            # recursion answers belong to another DC's store — no
+            # cache layer may keep them (query.no_store reaches the
+            # balancer as the do-not-store transport marker)
+            query.no_store = True
+            return self.recursion.resolve(query)
+        query.set_error(plan.rcode)
+        groups = plan.groups
+        if plan.rotatable:
+            groups = list(groups)
+            self.rng.shuffle(groups)
+        for answers, additionals in groups:
+            for rec in answers:
+                query.add_answer(rec)
+            for rec in additionals:
+                query.add_additional(rec)
+        for rec in plan.authorities:
+            query.add_authority(rec)
+        query.stamp("pre-resp")
+        query.respond()
 
     # -- reverse resolution (lib/server.js:67-134) --
 
     def resolve_ptr(self, query: QueryCtx):
-        domain = query.name()
-        parts = list(reversed(domain.split(".")))
+        plan = self.plan_ptr(query.name())
+        return self._finish(query, plan)
+
+    def plan_ptr(self, qname: str) -> AnswerPlan:
+        """Pure resolution of a PTR question against the reverse map."""
+        p = AnswerPlan()
+        parts = list(reversed(qname.split(".")))
         if len(parts) < 2 or parts[0] != "arpa" or parts[1] != "in-addr":
             # v6 reverse names included: the reference only serves IPv4 PTR
-            query.log_ctx["reason"] = "not an ipv4 reverse name"
-            query.set_error(Rcode.REFUSED)
-            query.respond()
-            return
+            p.reason = "not an ipv4 reverse name"
+            p.rcode = Rcode.REFUSED
+            return p
         # No octet validation: an invalid address simply misses the cache
         # and is REFUSED, so the client tries its next NS
         # (comment at lib/server.js:79-83)
@@ -302,29 +380,24 @@ class Resolver:
 
         if not self.cache.is_ready():
             self.log.error("no coordination-store session")
-            query.set_error(Rcode.SERVFAIL)
-            query.respond()
-            return
+            p.rcode = Rcode.SERVFAIL
+            return p
 
-        query.log_ctx["query"] = {"ip": ip, "type": query.qtype_name()}
+        p.log_query = {"ip": ip, "type": Type.name(Type.PTR)}
 
         # dependency tag: mutations touching this address emit the
         # normalized reverse qname (store/cache.py _rev_name)
-        query.dep_domain = domain.lower()
-        node = self.cache.reverse_lookup_traced(ip, query)
+        p.dep_domain = qname.lower()
+        node = self.cache.reverse_lookup(ip)
         if node is None:
-            if self.recursion is not None and query.rd():
-                query.no_store = True
-                return self.recursion.resolve(query)
-            query.set_error(Rcode.REFUSED)
-            query.stamp("pre-resp")
-            query.respond()
-            return
+            p.miss = True
+            p.rcode = Rcode.REFUSED
+            return p
 
         record = node.data if isinstance(node.data, dict) else {}
         rtype = record.get("type")
         sub = record.get(rtype) if isinstance(rtype, str) else None
         ttl = _record_ttl(record, sub if isinstance(sub, dict) else {})
-        query.add_answer(PTRRecord(name=domain, ttl=ttl, target=node.domain))
-        query.stamp("pre-resp")
-        query.respond()
+        p.groups.append(([PTRRecord(name=qname, ttl=ttl,
+                                    target=node.domain)], []))
+        return p

@@ -39,6 +39,7 @@ from binder_tpu.dns.wire import (
     Type,
     WireError,
     encode_name,
+    patch_answer_wire,
 )
 from binder_tpu.metrics.collector import (
     DEFAULT_SIZE_BUCKETS,
@@ -46,6 +47,7 @@ from binder_tpu.metrics.collector import (
     MetricsCollector,
 )
 from binder_tpu.resolver.answer_cache import AnswerCache
+from binder_tpu.resolver.precompile import Precompiler
 from binder_tpu.resolver.engine import (
     DEFAULT_TTL,
     Resolver,
@@ -150,6 +152,8 @@ class BinderServer:
                  cache_size: int = 10000,
                  cache_expiry_ms: int = 60000,
                  zone_precompile: bool = True,
+                 answer_precompile: bool = False,
+                 precompile_size: Optional[int] = None,
                  tcp_idle_timeout: Optional[float] = None,
                  max_tcp_conns: Optional[int] = None,
                  max_tcp_write_buffer: Optional[int] = None,
@@ -171,7 +175,8 @@ class BinderServer:
         # encoded-answer cache (the reference's -s/-a flags, main.js:34-38)
         self.zk_cache = zk_cache
         self.answer_cache = AnswerCache(size=cache_size,
-                                        expiry_ms=cache_expiry_ms)
+                                        expiry_ms=cache_expiry_ms,
+                                        compiled_size=precompile_size)
         self.cache_hit_counter = self.collector.counter(
             "binder_answer_cache_hits", "encoded-answer cache hits")
         self._cache_hit_child = self.cache_hit_counter.labelled()
@@ -213,6 +218,26 @@ class BinderServer:
             # arm the recursion fast path: its future callback completes
             # the query AND runs the engine's after hook itself
             recursion.engine_after = self._engine_after_hook
+
+        # Mutation-time answer precompilation (resolver/precompile.py):
+        # store mutations eagerly re-render the affected names' answers
+        # into the AnswerCache's compiled table, so post-churn (and
+        # seeded cold) queries are a dict probe + ID/flags patch instead
+        # of an engine.resolve() pass.  Off by default at this layer —
+        # main.py turns it on from config (`answerPrecompile`, default
+        # true) like the other production knobs.
+        self._precompiler: Optional[Precompiler] = None
+        if answer_precompile and cache_size > 0:
+            self._precompiler = Precompiler(
+                resolver=self.resolver, answer_cache=self.answer_cache,
+                zk_cache=zk_cache, summarize=self._summarize,
+                collector=self.collector, recorder=flight_recorder,
+                log=self.log, native_put=self._precompile_native_put)
+        self._precompile_serve_child = self.collector.counter(
+            "binder_precompile_serves",
+            "queries answered from mutation-time precompiled entries"
+        ).labelled()
+        self._precompile_serve_child.inc(0)   # series exists from scrape 1
         self.engine = DnsServer(log=self.log, name=name,
                                 tcp_idle_timeout=tcp_idle_timeout,
                                 max_tcp_conns=max_tcp_conns,
@@ -407,6 +432,12 @@ class BinderServer:
                     self._fastpath_push(key, self.zk_cache.epoch, query)
                 return None
 
+        # Mutation-time precompiled probe: a per-key miss whose answer
+        # was re-rendered at mutation time (or seeded at start) serves
+        # as a dict probe + ID/flags patch — the engine never runs.
+        if key is not None and self._serve_compiled(query, key, q0):
+            return None
+
         pending = self.resolver.handle(query)
 
         if (pending is None and key is not None and query.responded
@@ -427,10 +458,118 @@ class BinderServer:
             # the entry's first HIT (promote-on-first-hit above), never
             # here on the cold path.
             tag = query.dep_domain or q0.name
+            rcode = query.rcode()
             self.answer_cache.put(
                 key, epoch, (query.wire, ans, add),
-                rotatable=len(query.response.answers) > 1, tag=tag)
+                rotatable=len(query.response.answers) > 1, tag=tag,
+                # negative answers (NXDOMAIN / NODATA) cache like
+                # positives but are accounted separately; SERVFAIL is
+                # excluded above — the never-cache rule
+                negative=(rcode == Rcode.NXDOMAIN
+                          or (rcode == Rcode.NOERROR
+                              and not query.response.answers)),
+                qkey=(q0.qtype, q0.name))
         return pending
+
+    #: the client postures precompiled answers are installed under in
+    #: the NATIVE answer cache: (rd, edns, effective payload).  These
+    #: are the request shapes resolvers actually send (EDNS at the
+    #: 1232 safe default, classic 512 without); anything else (odd
+    #: payload advertisements, options) falls to the Python compiled
+    #: probe, which serves every posture by patching.
+    _NATIVE_POSTURES = ((False, False, MAX_UDP_PAYLOAD),
+                        (True, False, MAX_UDP_PAYLOAD),
+                        (False, True, 1232),
+                        (True, True, 1232))
+
+    def _precompile_native_put(self, qtype: int, qname: str, variants,
+                               tag: str, rcode: int) -> None:
+        """Install a precompiled answer set into the NATIVE answer
+        cache, one entry per canonical client posture — the
+        mutation-time analog of promote-on-first-hit.  The hit path IS
+        the C drain; installing at mutation time makes the post-churn
+        (and seeded cold) miss path take it from query one.  Pure
+        optimization: every failure path simply leaves the name to the
+        Python compiled probe.  Unlike query-path promotion, the push
+        cost lands on the mutation drain, never on a query."""
+        if self._fastpath is None:
+            return
+        qn = self._qname_wire(qname)
+        tag_wire = self._qname_wire(tag)
+        if qn is None or tag_wire is None:
+            return
+        # the C key builder only produces hostname-charset keys; an
+        # install outside that set could never be probed
+        i = 0
+        while qn[i]:
+            ll = qn[i]
+            if not _FP_NAME_OK.issuperset(qn[i + 1:i + 1 + ll]):
+                return
+            i += 1 + ll
+        frags = None
+        if self._log_ring:
+            # native serves must produce the same log line the Python
+            # compiled serve would ({"precompiled": true} + summaries)
+            frags = [self._log_frag({"precompiled": True}, rcode,
+                                    v[2], v[3]) for v in variants]
+            if any(f is None for f in frags):
+                return                  # unloggable: stays in Python
+        epoch = self.zk_cache.epoch
+        for rd, edns, payload in self._NATIVE_POSTURES:
+            wires = [patch_answer_wire(v[1] if edns else v[0], rd=rd)
+                     for v in variants]
+            if any(len(w) > payload for w in wires):
+                continue    # truncation shapes: the generic path owns TC
+            ckey = _fastpath_key_parts(rd, edns, payload, qtype, 1, qn)
+            try:
+                if frags is not None:
+                    _fastio.fastpath_put(self._fastpath, ckey, qtype,
+                                         epoch, wires, -1, tag_wire,
+                                         frags)
+                else:
+                    _fastio.fastpath_put(self._fastpath, ckey, qtype,
+                                         epoch, wires, -1, tag_wire)
+            except (TypeError, ValueError, MemoryError) as e:
+                self.log.debug("precompile native push skipped: %s", e)
+                return
+
+    def _serve_compiled(self, query: QueryCtx, key, q0) -> bool:
+        """Serve one query from the compiled-answer table, if present:
+        select the EDNS posture's pre-rendered wire, patch the RD bit
+        (the ID and question case are patched by respond_raw as for any
+        cached wire), respond, and install the result under the query's
+        exact key so repeats take the plain hit path (and promote to the
+        native fast path on their first hit, same economics as lazy
+        entries).  Declines (False) when the table has no entry or the
+        wire would need UDP truncation — the generic path owns those."""
+        if q0.qclass != 1:
+            return False
+        epoch = self.zk_cache.epoch
+        hit = self.answer_cache.get_compiled(q0.qtype, q0.name, epoch)
+        if hit is None:
+            return False
+        (w0, w1, ans, add), rotatable, tag, negative = hit
+        req = query.request
+        wire = w1 if req.edns is not None else w0
+        if query.udp_semantics and len(wire) > req.max_udp_payload():
+            return False
+        if req.rd:
+            wire = patch_answer_wire(wire, rd=True)
+        query.response.rcode = wire[3] & 0x0F   # for metrics/logs
+        query.log_ctx["precompiled"] = True
+        query.cached_summary = (ans, add)
+        query.stamp("precompile-hit")   # decode→probe→patch, whole serve
+        query.respond_raw(wire)
+        self._precompile_serve_child.inc()
+        try:
+            self.answer_cache.put(
+                key, epoch, (wire, ans, add), rotatable=rotatable,
+                tag=tag, negative=negative, qkey=(q0.qtype, q0.name))
+        except Exception:
+            # response already sent: bookkeeping must not re-raise into
+            # the dispatch path (it would SERVFAIL a served query)
+            self.log.exception("compiled-serve bookkeeping failed")
+        return True
 
     @staticmethod
     def _qname_wire(name: str) -> Optional[bytes]:
@@ -459,8 +598,11 @@ class BinderServer:
         name's refresh runs, its queries resolve through the raw lane /
         generic path — slower, never stale."""
         wires = []
+        # question shapes the drops touched — the precompiler's exact
+        # re-render work list (concrete negative SRV qnames, postures)
+        dropped: list = []
         for tag in tags:
-            self.answer_cache.invalidate_tag(tag)
+            self.answer_cache.invalidate_tag(tag, dropped=dropped)
             wire = self._qname_wire(tag)
             if wire is not None:
                 wires.append(wire)
@@ -475,6 +617,13 @@ class BinderServer:
                 pass
         if wires:
             self.engine.notify_invalidate(wires)
+        if self._precompiler is not None and dropped:
+            # refill work, deferred and bounded like the zone drain; the
+            # DROPS above were synchronous, so until a name's re-render
+            # runs its queries resolve lazily — slower, never stale.
+            # Only shapes with serving evidence (the dropped keys) are
+            # re-rendered: churn on unqueried names costs nothing here.
+            self._precompiler.enqueue(dropped)
         if self._zone_enabled:
             self._zone_dirty.update(tags)
             self._schedule_zone_drain()
@@ -1135,6 +1284,36 @@ class BinderServer:
                 self.log.exception("raw lane post-send bookkeeping failed")
             return True
 
+        # Mutation-time precompiled probe (the lane edition of
+        # _serve_compiled): a dict probe + RD patch + the same id/case
+        # splice as the hit path above, instead of the inline resolve
+        # below.  Declines to the resolve on truncation overflow.
+        comp = self.answer_cache.get_compiled(qtype_val, name, epoch)
+        if comp is not None:
+            (w0, w1, ans, add), rotatable, tag, negative = comp
+            cw = w1 if edns else w0
+            if not (udp_sem and len(cw) > payload):
+                if rd_flag:
+                    cw = patch_answer_wire(cw, rd=True)
+                wire = (data[:2] + cw[2:12] + data[12:q_end]
+                        + cw[q_end:])
+                send(wire)
+                try:
+                    self._precompile_serve_child.inc()
+                    self._lane_finish(data, src, protocol, start, wire,
+                                      wire[3] & 0x0F, edns, ans, add,
+                                      qtype=qtype_val, cached=True)
+                    self.answer_cache.put(
+                        key, epoch, (cw, ans, add), rotatable=rotatable,
+                        tag=tag, negative=negative,
+                        qkey=(qtype_val, name))
+                except Exception:
+                    # response already sent: never fall through to the
+                    # generic path (it would answer a second time)
+                    self.log.exception(
+                        "raw lane post-send bookkeeping failed")
+                return True
+
         # -- resolution --
         body = b""
         ancount = 0
@@ -1547,6 +1726,11 @@ class BinderServer:
     _PAIR_BIND_ATTEMPTS = 16
 
     async def start(self) -> None:
+        if self._precompiler is not None:
+            # compile the already-mirrored names (mirrors built before
+            # this server subscribed to invalidation events); mutation
+            # events keep the table fresh from here on
+            self._precompiler.seed_mirror()
         self._zone_fill()
         if self.balancer_socket:
             await self.engine.listen_balancer(self.balancer_socket)

@@ -584,9 +584,13 @@ class TestSnapshotValidator:
                        "last_rebuild_age_seconds": None},
             "answer_cache": {"size": 10, "entries": 0, "hits": 0,
                              "misses": 0, "hit_ratio": 0.0,
-                             "invalidations": 0, "expiry_ms": 1000.0},
+                             "invalidations": 0, "expiry_ms": 1000.0,
+                             "neg_hits": 0, "compiled_entries": 0,
+                             "compiled_serves": 0,
+                             "compiled_installs": 0},
             "inflight": {"count": 0, "queries": []},
-            "recursion": None, "loop": None, "flight_recorder": None,
+            "recursion": None, "precompile": None, "loop": None,
+            "flight_recorder": None,
         }
         assert validate_status_snapshot(good) == []
         bad = json.loads(json.dumps(good))

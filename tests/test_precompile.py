@@ -1,0 +1,492 @@
+"""Mutation-time answer precompilation (resolver/precompile.py).
+
+Pins the tentpole properties of the precompiled answer layer:
+
+- a store mutation re-renders the affected names' answers and installs
+  them, so the post-churn query is a compiled-table probe + ID/flags
+  patch (``log_ctx["precompiled"]``), never an engine resolve;
+- invalidate-then-reinstall under sustained churn keeps read-your-writes
+  (the drop is synchronous, the re-render immediate on the inline path);
+- precompiled wires are byte-for-byte what the engine would encode —
+  including every round-robin rotation variant, SRV answer+additional
+  sections, negative answers, and both EDNS postures (modulo the 16-bit
+  id, which is patched per query);
+- a watch storm that outruns the bounded work queue SHEDS (metrics +
+  flight-recorder event) and those names degrade to today's lazy
+  resolution — correct answers, just slower;
+- negative answers (NXDOMAIN / NODATA) are cached with their own
+  accounting; SERVFAIL is never cached or compiled;
+- the ``binder_precompile_*`` metric family is pinned by
+  ``tools/lint.py validate_precompile_metrics`` against the real
+  exposition text.
+"""
+import asyncio
+
+from binder_tpu.dns import Message, Rcode, Type, make_query
+from binder_tpu.dns.query import QueryCtx
+from binder_tpu.introspect import FlightRecorder
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+from tools.lint import validate_precompile_metrics
+
+DOMAIN = "foo.com"
+SVC = "/com/foo/svc"
+
+
+def build(precompile=True, recorder=None, **kw):
+    """Server over a fake store; fixtures are loaded AFTER construction
+    so every put_json is a live mutation event (the precompiler's input),
+    delivered synchronously (no loop -> inline compile)."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN, recorder=recorder)
+    store.start_session()
+    server = BinderServer(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="dc0",
+        collector=MetricsCollector(), query_log=False,
+        answer_precompile=precompile, flight_recorder=recorder, **kw)
+    return store, cache, server
+
+
+def ask(server, name, qtype, rd=False, edns=1232, qid=7):
+    sent = []
+    req = make_query(name, qtype, qid=qid, rd=rd, edns_payload=edns)
+    q = QueryCtx(req, ("127.0.0.1", 5353), "udp", sent.append)
+    pending = server._on_query(q)
+    assert pending is None
+    assert len(sent) == 1, "server must respond exactly once"
+    return Message.decode(sent[0]), sent[0], q
+
+
+def put_host(store, path, addr, **extra):
+    rec = {"type": "host", "host": {"address": addr}}
+    rec.update(extra)
+    store.put_json(path, rec)
+
+
+def put_service(store, n_members=3):
+    store.put_json(SVC, {"type": "service",
+                         "service": {"srvce": "_pg", "proto": "_tcp",
+                                     "port": 5432}})
+    for i in range(n_members):
+        store.put_json(f"{SVC}/lb{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.0.1.{i + 1}"}})
+
+
+def forbid_engine(server):
+    """Any resolve past the compiled table is a test failure."""
+    def boom(_query):
+        raise AssertionError("engine consulted; precompiled layer missed")
+    server.resolver.handle = boom
+
+
+class TestMutationInstalls:
+    """Mutation-path re-rendering is EVIDENCE-BASED: the shapes a
+    mutation's invalidation actually dropped (things being served) are
+    re-rendered eagerly; churn on unqueried names costs nothing.  The
+    startup seed covers the cold mirror.  So the pattern here is:
+    prime (one lazy query), mutate, then the engine is forbidden."""
+
+    def test_mutation_recompiles_served_host_answer(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        ask(server, "web.foo.com", Type.A, qid=1)         # evidence
+        put_host(store, "/com/foo/web", "10.9.9.9")       # mutation
+        forbid_engine(server)
+        r, _, q = ask(server, "web.foo.com", Type.A, qid=2)
+        assert r.rcode == Rcode.NOERROR
+        assert [a.address for a in r.answers] == ["10.9.9.9"]
+        assert q.log_ctx.get("precompiled") is True
+
+    def test_unqueried_churn_compiles_nothing(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        for i in range(5):
+            put_host(store, "/com/foo/web", f"10.1.2.{i + 4}")
+        assert server._precompiler.compiled == 0
+        assert server.answer_cache.stats()["compiled_entries"] == 0
+
+    def test_mutation_recompiles_served_ptr(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        ask(server, "3.2.1.10.in-addr.arpa", Type.PTR, qid=1)
+        # address unchanged, record rewritten (ttl added): the reverse
+        # shape's per-key entry drops and is re-rendered
+        put_host(store, "/com/foo/web", "10.1.2.3", ttl=55)
+        forbid_engine(server)
+        r, _, q = ask(server, "3.2.1.10.in-addr.arpa", Type.PTR, qid=2)
+        assert r.answers[0].target == "web.foo.com"
+        assert r.answers[0].ttl == 55
+        assert q.log_ctx.get("precompiled") is True
+
+    def test_mutation_recompiles_served_srv(self):
+        store, cache, server = build()
+        put_service(store)
+        ask(server, "_pg._tcp.svc.foo.com", Type.SRV, qid=1)
+        store.put_json(f"{SVC}/lb0",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": "10.0.9.9"}})
+        forbid_engine(server)
+        r, _, q = ask(server, "_pg._tcp.svc.foo.com", Type.SRV, qid=2)
+        assert r.rcode == Rcode.NOERROR
+        assert len(r.answers) == 3 and all(a.port == 5432
+                                           for a in r.answers)
+        addl = {a.name: a.address for a in r.additionals
+                if hasattr(a, "address")}
+        assert addl["lb0.svc.foo.com"] == "10.0.9.9"
+        assert q.log_ctx.get("precompiled") is True
+
+    def test_seed_mirror_compiles_preexisting_names(self):
+        # fixture loaded BEFORE the server subscribed: only the startup
+        # seed can compile it (the _zone_fill analog)
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        store.start_session()
+        put_host(store, "/com/foo/old", "10.9.9.9")
+        server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                              datacenter_name="dc0",
+                              collector=MetricsCollector(),
+                              query_log=False, answer_precompile=True)
+        server._precompiler.seed_mirror()
+        forbid_engine(server)
+        r, _, q = ask(server, "old.foo.com", Type.A)
+        assert [a.address for a in r.answers] == ["10.9.9.9"]
+        assert q.log_ctx.get("precompiled") is True
+        # the reverse shape seeded too
+        r, _, _q = ask(server, "9.9.9.10.in-addr.arpa", Type.PTR)
+        assert r.answers[0].target == "old.foo.com"
+
+    def test_servfail_shape_never_compiled(self):
+        store, cache, server = build()
+        store.put_json("/com/foo/junk", {"type": "host"})  # no sub-object
+        pc = server._precompiler
+        pc.seed_mirror()
+        assert pc.declined > 0
+        assert server.answer_cache.stats()["compiled_entries"] == 0
+        r, _, q = ask(server, "junk.foo.com", Type.A)
+        assert r.rcode == Rcode.SERVFAIL
+        assert "precompiled" not in q.log_ctx
+        # and the SERVFAIL was not cached either (the absolute rule)
+        assert server.answer_cache.stats()["entries"] == 0
+
+    def test_recursion_miss_not_compiled(self):
+        class _Rec:
+            pass
+        store, cache, server = build(recursion=_Rec())
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        store.rmr("/com/foo/web")
+        # the deleted name's answer is RD-dependent now (REFUSED vs
+        # cross-DC forward): only the lazy path may decide
+        assert server.answer_cache.get_compiled(
+            Type.A, "web.foo.com", cache.epoch) is None
+
+
+class TestChurn:
+    def test_invalidated_then_reinstalled_under_churn(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.0.0.1")
+        put_host(store, "/com/foo/stable", "10.7.7.7")
+        # serving evidence: one lazy query each
+        ask(server, "web.foo.com", Type.A, qid=1)
+        ask(server, "stable.foo.com", Type.A, qid=1)
+        for i in range(2, 60):
+            addr = f"10.0.{i % 250}.{i % 250}"
+            put_host(store, "/com/foo/web", addr)
+            r, _, q = ask(server, "web.foo.com", Type.A, qid=i)
+            # read-your-writes through the compiled path: the mutation's
+            # drop was synchronous and the re-render immediate, so the
+            # post-churn query serves the NEW address, precompiled
+            assert [a.address for a in r.answers] == [addr]
+            assert q.log_ctx.get("precompiled") is True
+            # the unmutated neighbor keeps serving (per-name selectivity)
+            r2, _, _q2 = ask(server, "stable.foo.com", Type.A, qid=i)
+            assert [a.address for a in r2.answers] == ["10.7.7.7"]
+
+    def test_dropped_negative_shape_reinstalled(self):
+        store, cache, server = build()
+        put_service(store)
+        # a concrete negative qname a client actually asked: cached by
+        # the query path with its question identity (qkey)
+        r, _, _q = ask(server, "_http._tcp.svc.foo.com", Type.SRV)
+        assert r.rcode == Rcode.NXDOMAIN
+        # churn the service: the dropped key's identity rides to the
+        # precompiler, which re-renders the negative eagerly
+        store.put_json(SVC, {"type": "service",
+                             "service": {"srvce": "_pg", "proto": "_tcp",
+                                         "port": 5433}})
+        forbid_engine(server)
+        r, _, q = ask(server, "_http._tcp.svc.foo.com", Type.SRV, qid=9)
+        assert r.rcode == Rcode.NXDOMAIN
+        assert q.log_ctx.get("precompiled") is True
+
+
+class TestWireParity:
+    """Precompiled wires must be byte-for-byte what the engine encodes
+    (modulo the 16-bit id and the rotation variant — here both are
+    pinned: same qid, rng stubbed to a known rotation)."""
+
+    def fixture_pair(self, load):
+        s1, c1, srv1 = build(precompile=True)
+        s2, c2, srv2 = build(precompile=False)
+        load(s1)
+        load(s2)
+        srv1._precompiler.seed_mirror()   # the cold-start walk
+        return srv1, srv2
+
+    def assert_parity(self, name, qtype, load, edns=1232, rd=False,
+                      prime=False):
+        """``prime=True`` for shapes only reachable through the
+        dropped-key path (concrete negative qnames): ask once lazily so
+        the question identity is cached, then mutate so the
+        invalidation hands it to the precompiler for re-render."""
+        srv_pre, srv_eng = self.fixture_pair(load)
+        if prime:
+            s1 = srv_pre.zk_cache.store
+            ask(srv_pre, name, qtype, qid=99, edns=edns, rd=rd)
+            load(s1)                    # re-put == mutation event
+        forbid_engine(srv_pre)
+        _, wire_pre, q = ask(srv_pre, name, qtype, qid=3, edns=edns,
+                             rd=rd)
+        assert q.log_ctx.get("precompiled") is True
+        _, wire_eng, _q = ask(srv_eng, name, qtype, qid=3, edns=edns,
+                              rd=rd)
+        assert wire_pre == wire_eng
+
+    def test_host_a_parity(self):
+        load = lambda s: put_host(s, "/com/foo/web", "10.1.2.3", ttl=77)
+        self.assert_parity("web.foo.com", Type.A, load)
+        self.assert_parity("web.foo.com", Type.A, load, edns=None)
+        self.assert_parity("web.foo.com", Type.A, load, rd=True)
+
+    def test_database_parity(self):
+        self.assert_parity("pg.foo.com", Type.A, lambda s: s.put_json(
+            "/com/foo/pg",
+            {"type": "database",
+             "database": {"primary": "tcp://10.99.99.14:5432/x"}}))
+
+    def test_ptr_parity(self):
+        self.assert_parity(
+            "3.2.1.10.in-addr.arpa", Type.PTR,
+            lambda s: put_host(s, "/com/foo/web", "10.1.2.3"))
+
+    def test_nodata_soa_parity(self):
+        load = lambda s: put_host(s, "/com/foo/web", "10.1.2.3", ttl=60)
+        self.assert_parity("_pg._tcp.web.foo.com", Type.SRV, load,
+                           prime=True)
+        self.assert_parity("_pg._tcp.web.foo.com", Type.SRV, load,
+                           edns=None, prime=True)
+
+    def test_nxdomain_parity(self):
+        self.assert_parity("_http._udp.svc.foo.com", Type.SRV,
+                           put_service, prime=True)
+
+    class _RotRng:
+        """shuffle() = rotate left by k — the cyclic variant the
+        precompiler renders as variant k."""
+
+        def __init__(self, k):
+            self.k = k
+
+        def shuffle(self, lst):
+            k = self.k % len(lst) if lst else 0
+            lst[:] = lst[k:] + lst[:k]
+
+    def test_rotation_variant_parity_plain_a(self):
+        for k in range(3):
+            srv_pre, srv_eng = self.fixture_pair(put_service)
+            srv_eng.resolver.rng = self._RotRng(k)
+            forbid_engine(srv_pre)
+            # compiled serves rotate 0,1,2,... — advance to variant k
+            for i in range(k):
+                ask(srv_pre, "svc.foo.com", Type.A, qid=50 + i)
+            _, wire_pre, q = ask(srv_pre, "svc.foo.com", Type.A, qid=3)
+            assert q.log_ctx.get("precompiled") is True
+            _, wire_eng, _q = ask(srv_eng, "svc.foo.com", Type.A, qid=3)
+            assert wire_pre == wire_eng
+
+    def test_rotation_variant_parity_srv(self):
+        for k in range(3):
+            srv_pre, srv_eng = self.fixture_pair(put_service)
+            srv_eng.resolver.rng = self._RotRng(k)
+            forbid_engine(srv_pre)
+            for i in range(k):
+                ask(srv_pre, "_pg._tcp.svc.foo.com", Type.SRV,
+                    qid=50 + i)
+            _, wire_pre, q = ask(srv_pre, "_pg._tcp.svc.foo.com",
+                                 Type.SRV, qid=3)
+            assert q.log_ctx.get("precompiled") is True
+            _, wire_eng, _q = ask(srv_eng, "_pg._tcp.svc.foo.com",
+                                  Type.SRV, qid=3)
+            assert wire_pre == wire_eng
+
+    def test_all_variants_cover_member_set(self):
+        store, cache, server = build()
+        put_service(store)
+        server._precompiler.seed_mirror()
+        forbid_engine(server)
+        firsts = set()
+        for i in range(3):
+            r, _, _q = ask(server, "svc.foo.com", Type.A, qid=i + 1)
+            assert sorted(a.address for a in r.answers) == \
+                ["10.0.1.1", "10.0.1.2", "10.0.1.3"]
+            firsts.add(r.answers[0].address)
+        # round-robin: consecutive serves lead with different members
+        assert len(firsts) == 3
+
+
+class TestStormShedding:
+    def test_storm_sheds_to_lazy(self):
+        recorder = FlightRecorder(capacity=64)
+
+        async def run():
+            store, cache, server = build(recorder=recorder)
+            pc = server._precompiler
+            pc.MAX_PENDING = 4          # instance shadow of the bound
+            # 40 served names (the evidence that makes their mutations
+            # re-render work)
+            for i in range(40):
+                put_host(store, f"/com/foo/s{i}", f"10.1.0.{i + 1}")
+                ask(server, f"s{i}.foo.com", Type.A, qid=i + 1)
+            await asyncio.sleep(0)
+            # storm: every served name mutated within one loop pass (no
+            # drain runs in between) — far more work than the queue
+            # admits
+            for i in range(40):
+                put_host(store, f"/com/foo/s{i}", f"10.2.0.{i + 1}")
+            assert pc.shed > 0
+            assert len(pc._pending) <= pc.MAX_PENDING
+            # lazy fallback: a shed name still answers correctly (the
+            # engine path), just without the precompiled serve
+            r, _, q = ask(server, "s39.foo.com", Type.A, qid=99)
+            assert r.rcode == Rcode.NOERROR
+            assert [a.address for a in r.answers] == ["10.2.0.40"]
+            # draining the queue compiles what was admitted
+            while pc._pending:
+                await asyncio.sleep(0)
+            assert pc.compiled > 0
+            return server
+
+        asyncio.run(run())
+        events = [e for e in recorder.events()
+                  if e["type"] == "precompile-shed"]
+        assert events, "shedding must leave flight-recorder evidence"
+        assert events[0]["shed"] > 0
+
+    def test_shed_then_requeued_on_next_mutation(self):
+        async def run():
+            store, cache, server = build()
+            pc = server._precompiler
+            for i in range(10):
+                put_host(store, f"/com/foo/b{i}", f"10.2.0.{i + 1}")
+                ask(server, f"b{i}.foo.com", Type.A, qid=i + 1)
+            await asyncio.sleep(0)
+            pc.MAX_PENDING = 2
+            for i in range(10):
+                put_host(store, f"/com/foo/b{i}", f"10.3.0.{i + 1}")
+            assert pc.shed > 0
+            while pc._pending:
+                await asyncio.sleep(0)
+            # a fresh mutation of a (possibly shed) name re-renders it
+            # normally once the storm is over and the bound is back
+            pc.MAX_PENDING = type(pc).MAX_PENDING
+            ask(server, "b9.foo.com", Type.A, qid=90)   # evidence again
+            put_host(store, "/com/foo/b9", "10.2.9.9")
+            while pc._pending:
+                await asyncio.sleep(0)
+            forbid_engine(server)
+            r, _, q = ask(server, "b9.foo.com", Type.A, qid=91)
+            assert [a.address for a in r.answers] == ["10.2.9.9"]
+            assert q.log_ctx.get("precompiled") is True
+
+        asyncio.run(run())
+
+
+class TestNegativeCaching:
+    def count_engine(self, server):
+        calls = {"n": 0}
+        inner = server.resolver.handle
+
+        def counting(query):
+            calls["n"] += 1
+            return inner(query)
+        server.resolver.handle = counting
+        return calls
+
+    def test_nxdomain_cached_with_accounting(self):
+        store, cache, server = build(precompile=False)
+        put_service(store)
+        calls = self.count_engine(server)
+        r, _, _q = ask(server, "_http._tcp.svc.foo.com", Type.SRV,
+                       qid=1)
+        assert r.rcode == Rcode.NXDOMAIN
+        r, _, q = ask(server, "_http._tcp.svc.foo.com", Type.SRV, qid=2)
+        assert r.rcode == Rcode.NXDOMAIN
+        assert calls["n"] == 1, "repeat negative must not hit the engine"
+        assert server.answer_cache.stats()["neg_hits"] == 1
+
+    def test_nodata_cached(self):
+        store, cache, server = build(precompile=False)
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        calls = self.count_engine(server)
+        for qid in (1, 2):
+            r, _, _q = ask(server, "_pg._tcp.web.foo.com", Type.SRV,
+                           qid=qid)
+            assert r.rcode == Rcode.NOERROR and not r.answers
+            assert r.authorities
+        assert calls["n"] == 1
+
+    def test_negative_invalidated_by_its_tag(self):
+        store, cache, server = build(precompile=False)
+        put_service(store)
+        r, _, _q = ask(server, "_http._tcp.svc.foo.com", Type.SRV,
+                       qid=1)
+        assert r.rcode == Rcode.NXDOMAIN
+        # the service re-registers under the asked name: the cached
+        # negative must die with its dependency tag
+        store.put_json(SVC, {"type": "service",
+                             "service": {"srvce": "_http",
+                                         "proto": "_tcp", "port": 80}})
+        r, _, _q = ask(server, "_http._tcp.svc.foo.com", Type.SRV,
+                       qid=2)
+        assert r.rcode == Rcode.NOERROR and r.answers
+
+    def test_servfail_never_cached(self):
+        store, cache, server = build(precompile=False)
+        store.put_json("/com/foo/junk", {"type": "host"})
+        calls = self.count_engine(server)
+        for qid in (1, 2, 3):
+            r, _, _q = ask(server, "junk.foo.com", Type.A, qid=qid)
+            assert r.rcode == Rcode.SERVFAIL
+        assert calls["n"] == 3, "every SERVFAIL must re-check the store"
+
+
+class TestMetrics:
+    def test_precompile_exposition_validates(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        ask(server, "web.foo.com", Type.A)
+        text = server.collector.expose()
+        assert validate_precompile_metrics(text) == []
+        assert "binder_precompile_compiled" in text
+        assert "binder_precompile_serves" in text
+
+    def test_validator_rejects_missing_family(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        text = server.collector.expose()
+        broken = "\n".join(
+            ln for ln in text.splitlines()
+            if "binder_precompile_shed" not in ln) + "\n"
+        assert any("binder_precompile_shed" in e
+                   for e in validate_precompile_metrics(broken))
+
+    def test_introspect_section(self):
+        store, cache, server = build()
+        put_host(store, "/com/foo/web", "10.1.2.3")
+        server._precompiler.seed_mirror()
+        pc = server._precompiler.introspect()
+        assert pc["compiled"] >= 1
+        assert pc["queue_depth"] == 0
+        assert pc["max_pending"] > 0

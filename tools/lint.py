@@ -458,7 +458,10 @@ _SNAPSHOT_SCHEMA = {
         "size": (int, False), "entries": (int, False),
         "hits": (int, False), "misses": (int, False),
         "hit_ratio": (_NUM, False), "invalidations": (int, False),
-        "expiry_ms": (_NUM, False),
+        "expiry_ms": (_NUM, False), "neg_hits": (int, False),
+        "compiled_entries": (int, False),
+        "compiled_serves": (int, False),
+        "compiled_installs": (int, False),
     },
     "inflight": {
         "count": (int, False), "queries": (list, False),
@@ -501,7 +504,7 @@ def validate_status_snapshot(snap):
         _check_keys(sub, schema, section, errs)
     # nullable top-level sections must still be PRESENT (consumers key
     # on them to know the feature is off, not mistyped)
-    for section in ("recursion", "loop", "flight_recorder"):
+    for section in ("recursion", "precompile", "loop", "flight_recorder"):
         if section not in snap:
             errs.append(f"{section}: key must be present (null when "
                         "the subsystem is off)")
@@ -550,6 +553,56 @@ def validate_status_snapshot(snap):
                 if isinstance(ev, dict)]
         if seqs != sorted(seqs):
             errs.append("flight_recorder.events: seq not ascending")
+    pc = snap.get("precompile")
+    if isinstance(pc, dict):
+        for key in ("queue_depth", "max_pending", "batch", "compiled",
+                    "declined", "shed"):
+            if key not in pc:
+                errs.append(f"precompile: missing {key!r}")
+    return errs
+
+
+# ---- mutation-time precompiler metrics validator ----
+#
+# The precompiler's operational story lives in its metrics: compiled /
+# declined / shed counters plus the live queue-depth gauge.  An exporter
+# bug that silently dropped one of them would leave storm shedding
+# invisible — exactly the failure mode the bounded queue exists to
+# surface.  validate_precompile_metrics() checks a scrape exposition for
+# the full binder_precompile_* family with the right TYPEs.  Wired into
+# tier-1 via tests/test_precompile.py alongside validate_exposition.
+
+_PRECOMPILE_FAMILIES = {
+    "binder_precompile_compiled": "counter",
+    "binder_precompile_declined": "counter",
+    "binder_precompile_shed": "counter",
+    "binder_precompile_queue_depth": "gauge",
+    "binder_precompile_serves": "counter",
+}
+
+
+def validate_precompile_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_precompile_*`` family (correct TYPE declarations and at
+    least one sample each).  Returns error strings; empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    sampled = set()
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            name = parts[0].split("{", 1)[0]
+            sampled.add(name)
+    for family, kind in _PRECOMPILE_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in sampled:
+            errs.append(f"{family}: no samples in exposition")
     return errs
 
 
