@@ -1484,6 +1484,113 @@ def _bench_balancer_overhead(tmpdir: str) -> Dict[str, object]:
             _reap(p)
 
 
+N_DEGRADED = int(os.environ.get("BENCH_DEGRADED_QUERIES",
+                                str(min(20000, N_QUERIES))))
+
+
+def _scrape_gauge(metrics_port: int, name: str) -> Optional[float]:
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics",
+                timeout=5) as r:
+            text = r.read().decode()
+    except OSError:
+        return None
+    m = re.search(rf"^{re.escape(name)}(?:\{{[^}}]*\}})? ([0-9.eE+-]+)$",
+                  text, re.M)
+    return float(m.group(1)) if m else None
+
+
+def _bench_degraded(tmpdir: str) -> Dict[str, object]:
+    """Degradation axis (`--chaos` posture, ISSUE 4): the SAME hot
+    host-A mix served in the three policy states, with the server's
+    own `chaos` config block scripting the session loss in-process
+    (docs/degradation.md):
+
+    - `degraded_qps` — **stale-serving**: session killed at start,
+      cap effectively infinite; every answer rides the generic path
+      with TTL clamping (the raw lane and native fast path stand down
+      when degraded), so this figure is the honest cost of degraded
+      serving vs the fresh headline;
+    - `withheld_qps` — **stale-exhausted**: cap ~0; every query gets
+      an immediate well-formed SERVFAIL — the refusal throughput
+      under total store loss (a hang or timeout here would tank the
+      figure; the bound IS the property);
+    - scrape-asserted: `binder_degraded_state` reads 1 / 2 in the
+      respective phases and the stale counters advance — the axis
+      fails rather than silently measuring the wrong state."""
+    fix = {f"/com/bench/w{i}": {
+        "type": "host", "host": {"address": f"10.30.0.{i + 1}"}}
+        for i in range(64)}
+    fixture = os.path.join(tmpdir, "degraded_fixture.json")
+    with open(fixture, "w") as f:
+        json.dump(fix, f)
+    tmpl = os.path.join(tmpdir, "degraded_queries.bin")
+    _write_templates(tmpl, [(f"w{i}.bench.com", Type.A)
+                            for i in range(64)])
+    probe = make_query("w0.bench.com", Type.A, qid=1).encode()
+
+    def phase(tag: str, max_staleness: float,
+              want_state: float) -> Dict[str, float]:
+        config = os.path.join(tmpdir, f"degraded_config_{tag}.json")
+        with open(config, "w") as f:
+            json.dump({
+                "dnsDomain": "bench.com", "datacenterName": "dc0",
+                "host": "127.0.0.1",
+                "store": {"backend": "fake", "fixture": fixture},
+                "queryLog": False,
+                "degradation": {"maxStalenessSeconds": max_staleness,
+                                "staleTtlClampSeconds": 5},
+                "chaos": {"plan": "at 0.0 lose-session"},
+            }, f)
+        proc = _launch_server(config)
+        try:
+            port, mport = wait_for_ports(proc)
+            if want_state < 2:
+                _wait_ready(port, probe, f"degraded axis ({tag})")
+            # the scripted session loss must have landed (and, for the
+            # exhausted phase, aged past the cap) before measuring
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if _scrape_gauge(mport, "binder_degraded_state") \
+                        == want_state:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    f"degraded axis: state never reached {want_state}")
+            res = _median_passes(
+                lambda: _drive_native(port, tmpdir, tmpl_path=tmpl,
+                                      n=N_DEGRADED), N_PASSES)
+            if _scrape_gauge(mport, "binder_degraded_state") \
+                    != want_state:
+                raise RuntimeError(
+                    f"degraded axis: state drifted mid-measurement "
+                    f"({tag})")
+            res["stale_served"] = _scrape_gauge(
+                mport, "binder_stale_served_total")
+            res["withheld"] = _scrape_gauge(
+                mport, "binder_stale_withheld_total")
+            return res
+        finally:
+            _reap(proc)
+
+    stale = phase("stale", 86400.0, 1.0)
+    exhausted = phase("exhausted", 0.05, 2.0)
+    if not stale.get("stale_served"):
+        raise RuntimeError("degraded axis measured zero stale serves")
+    if exhausted["errors"] < N_DEGRADED:
+        raise RuntimeError("exhausted phase served data answers")
+    return {
+        "qps": stale["qps"], "qps_spread": stale.get("qps_spread"),
+        "p50_us": stale["p50_us"], "p99_us": stale["p99_us"],
+        "withheld_qps": exhausted["qps"],
+        "withheld_p99_us": exhausted["p99_us"],
+        "queries": N_DEGRADED,
+    }
+
+
 def _try_axis(name: str, fn, retries: int = 1):
     """Run one bench axis, retrying once on failure: every axis is
     exception-guarded so a transient (a busy box stretching a startup
@@ -1502,7 +1609,7 @@ def _try_axis(name: str, fn, retries: int = 1):
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
-    realistic = None
+    realistic = degraded = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -1524,6 +1631,8 @@ def run_bench() -> Dict[str, object]:
                               lambda: _bench_recursion(tmpdir))
             realistic = _try_axis("realistic",
                                   lambda: _bench_realistic(tmpdir))
+            degraded = _try_axis("degraded",
+                                 lambda: _bench_degraded(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -1696,6 +1805,20 @@ def run_bench() -> Dict[str, object]:
         out["realistic_log_lines"] = realistic.get("log_lines")
         if realistic.get("precompile"):
             out["realistic_precompile"] = realistic["precompile"]
+    if degraded is not None:
+        # degradation axis (ISSUE 4): the hot mix served STALE
+        # (session lost, within cap — TTL-clamped generic path, raw
+        # lane/native standing down) and WITHHELD (past cap — every
+        # query an immediate well-formed SERVFAIL); both scripted via
+        # the server's own chaos config block and scrape-asserted to
+        # be measuring the intended state (docs/degradation.md)
+        out["degraded_qps"] = round(degraded["qps"], 1)
+        out["degraded_qps_spread"] = degraded.get("qps_spread")
+        out["degraded_p50_us"] = round(degraded["p50_us"], 1)
+        out["degraded_p99_us"] = round(degraded["p99_us"], 1)
+        out["degraded_withheld_qps"] = round(degraded["withheld_qps"], 1)
+        out["degraded_withheld_p99_us"] = round(
+            degraded["withheld_p99_us"], 1)
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm,
         # with the balancer's own per-stage attribution riding along

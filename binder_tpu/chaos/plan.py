@@ -1,0 +1,235 @@
+"""FaultPlan: a scriptable fault-injection schedule.
+
+The degraded paths are only trustworthy if they are *tested* the way
+the hot path is benched — ZDNS-style measurement discipline applied to
+failure.  A :class:`FaultPlan` is a timeline of fault actions plus the
+live upstream-fault state, injectable into the fake store, the ZK test
+server, and the chaos upstream (``chaos/upstream.py``), and scriptable
+from three places: unit tests (build it in code), ``make chaos-smoke``
+(the DSL below), and the bench's degraded axis (a ``chaos`` config
+block, ``main.py``).
+
+DSL — one action per line (``;`` also separates), ``#`` comments::
+
+    at 0.5  lose-session            # store goes dark, mirror starts aging
+    at 1.0  watch-storm n=600       # mutation burst through the store
+    at 2.0  loop-stall ms=120       # synchronous event-loop stall
+    at 2.5  upstream loss=0.3 delay_ms=40 dup=0.05
+    at 4.0  expire-session          # loss + immediate re-establish
+    at 5.0  restore-session         # plain re-establish
+    at 6.0  upstream clear          # all upstream faults off
+
+Actions
+-------
+- ``lose-session`` / ``restore-session`` / ``expire-session`` — drive
+  the store's session test hooks (``FakeStore.lose_session`` /
+  ``start_session`` / ``expire_session``; the ZK test server's
+  ``drop_connections`` / ``expire_session`` via duck typing).
+- ``watch-storm n=N`` — apply N mutations through the driver's
+  ``mutate`` callback (the caller owns what a mutation writes).
+- ``loop-stall ms=M`` — block the event loop synchronously for M ms
+  (what a GC pause / runaway callback does to serving).
+- ``upstream k=v ...`` — set live fault knobs consumed by
+  :class:`~binder_tpu.chaos.upstream.ChaosUpstream`: ``loss`` (drop
+  probability), ``delay_ms`` (response delay, making a slow peer),
+  ``dup`` (duplicate-response probability), ``truncate`` (1 = answer
+  TC=1 with no answers, forcing the TCP retry path), ``dead`` (1 =
+  drop everything).  ``upstream clear`` resets all of them.
+
+Determinism: the plan carries its own seeded RNG; two runs with the
+same seed inject byte-identical fault decisions.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Callable, List, Optional, Tuple
+
+ACTIONS = ("lose-session", "restore-session", "expire-session",
+           "watch-storm", "loop-stall", "upstream")
+
+
+class UpstreamFaults:
+    """Live fault state the chaos upstream consults per packet."""
+
+    __slots__ = ("loss", "delay_ms", "dup", "truncate", "dead")
+
+    def __init__(self) -> None:
+        self.clear()
+
+    def clear(self) -> None:
+        self.loss = 0.0
+        self.delay_ms = 0.0
+        self.dup = 0.0
+        self.truncate = False
+        self.dead = False
+
+    def set(self, **kw) -> None:
+        for key, val in kw.items():
+            if key == "clear":
+                self.clear()
+            elif key in ("loss", "delay_ms", "dup"):
+                setattr(self, key, float(val))
+            elif key in ("truncate", "dead"):
+                setattr(self, key, bool(int(val)))
+            else:
+                raise ValueError(f"unknown upstream fault knob {key!r}")
+
+    def snapshot(self) -> dict:
+        return {"loss": self.loss, "delay_ms": self.delay_ms,
+                "dup": self.dup, "truncate": self.truncate,
+                "dead": self.dead}
+
+
+class FaultPlan:
+    """Timeline of (t_offset_seconds, action, kwargs) + live state."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.timeline: List[Tuple[float, str, dict]] = []
+        self.upstream = UpstreamFaults()
+        self.rng = random.Random(seed)
+        self.seed = seed
+
+    def at(self, t: float, action: str, **kwargs) -> "FaultPlan":
+        """Append one scheduled action (builder style, chainable)."""
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}")
+        self.timeline.append((float(t), action, kwargs))
+        self.timeline.sort(key=lambda e: e[0])
+        return self
+
+    @property
+    def duration(self) -> float:
+        return self.timeline[-1][0] if self.timeline else 0.0
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse the DSL above.  Raises ValueError with the offending
+        fragment on any malformed line — a chaos script that silently
+        does nothing is worse than none."""
+        plan = cls(seed=seed)
+        for raw_line in spec.replace(";", "\n").splitlines():
+            line = raw_line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            if len(toks) < 3 or toks[0] != "at":
+                raise ValueError(f"chaos spec: expected "
+                                 f"'at <t> <action> ...': {line!r}")
+            try:
+                t = float(toks[1])
+            except ValueError:
+                raise ValueError(f"chaos spec: bad time {toks[1]!r}")
+            action = toks[2]
+            kwargs: dict = {}
+            for tok in toks[3:]:
+                if tok == "clear":
+                    kwargs["clear"] = True
+                    continue
+                if "=" not in tok:
+                    raise ValueError(f"chaos spec: expected k=v, "
+                                     f"got {tok!r}")
+                k, v = tok.split("=", 1)
+                try:
+                    kwargs[k] = float(v) if "." in v else int(v)
+                except ValueError:
+                    raise ValueError(f"chaos spec: bad value {tok!r}")
+            plan.at(t, action, **kwargs)
+        return plan
+
+
+class ChaosDriver:
+    """Binds a :class:`FaultPlan` to live targets and runs it.
+
+    Targets are all optional — a plan driven only at an upstream needs
+    no store, and vice versa.  ``mutate`` is called ``mutate(i)`` per
+    watch-storm mutation; the caller decides what churn means for its
+    fixture.  Every applied action is flight-recorded
+    (``chaos-inject``) so a soak's failure report can line the
+    injected faults up against the observed transitions.
+    """
+
+    def __init__(self, plan: FaultPlan, *, store=None,
+                 mutate: Optional[Callable[[int], None]] = None,
+                 recorder=None,
+                 log: Optional[logging.Logger] = None) -> None:
+        self.plan = plan
+        self.store = store
+        self.mutate = mutate
+        self.recorder = recorder
+        self.log = log or logging.getLogger("binder.chaos")
+        self.applied: List[Tuple[float, str]] = []
+        self.started_mono: Optional[float] = None
+
+    # -- action dispatch --
+
+    def apply(self, action: str, kwargs: dict) -> None:
+        """Apply one action NOW (also the unit-test entry — no loop
+        needed)."""
+        if action == "upstream":
+            self.plan.upstream.set(**kwargs)
+        elif action == "watch-storm":
+            n = int(kwargs.get("n", 100))
+            if self.mutate is None:
+                self.log.warning("chaos: watch-storm with no mutate "
+                                 "target; skipped")
+            else:
+                for i in range(n):
+                    self.mutate(i)
+        elif action == "loop-stall":
+            time.sleep(float(kwargs.get("ms", 100)) / 1000.0)
+        elif action in ("lose-session", "restore-session",
+                        "expire-session"):
+            self._session_action(action)
+        else:
+            raise ValueError(f"unknown chaos action {action!r}")
+        self.applied.append((time.monotonic(), action))
+        if self.recorder is not None:
+            self.recorder.record("chaos-inject", action=action, **{
+                k: v for k, v in kwargs.items()})
+        self.log.info("chaos: injected %s %s", action, kwargs or "")
+
+    def _session_action(self, action: str) -> None:
+        st = self.store
+        if st is None:
+            self.log.warning("chaos: %s with no store target; skipped",
+                             action)
+            return
+        if action == "lose-session":
+            # FakeStore.lose_session; the ZK test server's analog is
+            # severing this member's connections without expiry
+            fn = getattr(st, "lose_session", None) \
+                or getattr(st, "drop_connections", None)
+        elif action == "expire-session":
+            fn = getattr(st, "expire_session", None)
+        else:
+            # restore: FakeStore.start_session; the real client
+            # re-establishes on its own once connections are allowed
+            fn = getattr(st, "start_session", None)
+        if fn is None:
+            self.log.warning("chaos: store %s has no hook for %s",
+                             type(st).__name__, action)
+            return
+        fn()
+
+    # -- the scripted run --
+
+    async def run(self) -> None:
+        """Play the plan's timeline against the targets.  Sleeps are
+        relative to the run's own start; actions land within event-loop
+        scheduling accuracy of their scripted instants."""
+        loop = asyncio.get_running_loop()
+        self.started_mono = loop.time()
+        for t, action, kwargs in self.plan.timeline:
+            delay = self.started_mono + t - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                self.apply(action, kwargs)
+            except Exception:  # noqa: BLE001 — keep injecting
+                self.log.exception("chaos action %s failed", action)
+
+    def start(self) -> "asyncio.Task":
+        return asyncio.ensure_future(self.run())

@@ -49,7 +49,7 @@ class QueryCtx:
                  "client_transport", "_send", "_responded", "bytes_sent",
                  "start", "_last_stamp", "times", "log_ctx", "raw", "wire",
                  "cached_summary", "no_store", "dep_domain",
-                 "want_log_detail", "trace_id")
+                 "want_log_detail", "trace_id", "after_done")
 
     def __init__(self, request: Message,
                  src: Tuple[str, int],
@@ -85,6 +85,10 @@ class QueryCtx:
         # summaries
         self.want_log_detail = False
         self._responded = False
+        # latched by the engine's _after: a query that was SHED (overload
+        # admission responded for it) must not be metered again when its
+        # original completion path finally runs
+        self.after_done = False
         self.bytes_sent = 0
         self.start = time.monotonic()
         self._last_stamp = self.start
@@ -137,6 +141,18 @@ class QueryCtx:
 
     def add_additional(self, record: Record) -> None:
         self.response.additionals.append(record)
+
+    def reset_sections(self) -> None:
+        """Drop any half-built (possibly unencodable) answer set while
+        KEEPING the EDNS echo: error responses (SERVFAIL after a
+        handler failure, overload REFUSED) must carry the query's EDNS
+        posture — a bare `additionals.clear()` silently stripped the
+        OPT and broke EDNS conformance on every error path."""
+        self.response.answers.clear()
+        self.response.authorities.clear()
+        self.response.additionals.clear()
+        if self.request.edns is not None:
+            self.response.additionals.append(_ECHO_OPT)
 
     # -- timers (lib/server.js:476-483) --
 

@@ -185,6 +185,14 @@ class DnsServer:
         # ID / phase stamps the status endpoint reads.  The sync hot
         # path pays nothing.
         self.inflight: dict = {}
+        # driver task per async in-flight query (same key): overload
+        # shedding must be able to cancel the work it refuses, not just
+        # answer for it (AdmissionControl.shed_overflow)
+        self.inflight_tasks: dict = {}
+        # Overload admission control (binder_tpu/policy/admission.py),
+        # installed by BinderServer: bounds the in-flight table with
+        # oldest-shed.  None = unbounded (the classic behavior).
+        self.admission = None
         # Optional flight recorder (installed by BinderServer): the
         # engine's error path records resolver-error events on it.
         self.recorder = None
@@ -224,11 +232,18 @@ class DnsServer:
             self._after(query)
             return
         self.inflight[id(query)] = query
-        if pending is HANDLED_ASYNC:
-            return    # handler completes (and runs after) via callbacks
-        task = asyncio.ensure_future(self._run_async(query, pending))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        if pending is not HANDLED_ASYNC:
+            task = asyncio.ensure_future(self._run_async(query, pending))
+            self._tasks.add(task)
+            self.inflight_tasks[id(query)] = task
+            task.add_done_callback(self._tasks.discard)
+        # overload admission: past the cap, the OLDEST in-flight query
+        # is shed (immediate well-formed REFUSED + task cancel) so the
+        # table bounds memory and upstream fan-out — a storm of stuck
+        # forwards can never grow it without bound
+        adm = self.admission
+        if adm is not None and len(self.inflight) > adm.max_inflight:
+            adm.shed_overflow(self)
 
     async def _run_async(self, query: QueryCtx, pending) -> None:
         try:
@@ -240,6 +255,7 @@ class DnsServer:
 
     def _on_query_error(self, query: QueryCtx, e: Exception) -> None:
         self.inflight.pop(id(query), None)
+        self.inflight_tasks.pop(id(query), None)
         if self.recorder is not None:
             self.recorder.record(
                 "resolver-error", trace=query.trace_id,
@@ -252,10 +268,11 @@ class DnsServer:
             return
         self.log.error("query handler failed", exc_info=e)
         if not query.responded:
-            # drop any half-built (possibly unencodable) answer set
-            query.response.answers.clear()
-            query.response.authorities.clear()
-            query.response.additionals.clear()
+            # drop any half-built (possibly unencodable) answer set —
+            # reset_sections keeps the EDNS echo, so the SERVFAIL
+            # carries the query's EDNS posture (RFC 6891 conformance,
+            # pinned by tests/test_recursion.py)
+            query.reset_sections()
             query.set_error(Rcode.SERVFAIL)
             try:
                 query.respond()
@@ -264,6 +281,10 @@ class DnsServer:
 
     def _after(self, query: QueryCtx) -> None:
         self.inflight.pop(id(query), None)
+        self.inflight_tasks.pop(id(query), None)
+        if query.after_done:
+            return   # already metered (overload shed answered for it)
+        query.after_done = True
         if self.on_after is not None and query.responded:
             try:
                 self.on_after(query)

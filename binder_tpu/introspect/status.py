@@ -134,6 +134,7 @@ class Introspector:
             "inflight": self._inflight_section(),
             "recursion": self._recursion_section(),
             "precompile": self._precompile_section(),
+            "policy": self._policy_section(),
             "loop": (self.watchdog.snapshot()
                      if self.watchdog is not None else None),
             "flight_recorder": self._recorder_section(),
@@ -222,6 +223,26 @@ class Introspector:
     def _recursion_section(self) -> Optional[dict]:
         rec = self.recursion
         return None if rec is None else rec.introspect()
+
+    def _policy_section(self) -> Optional[dict]:
+        """Degradation policy engine state (null when the whole layer
+        is off): the stale-serve state machine, overload admission
+        counters, and the recursion breakers' worst state — the
+        "is binder degraded, and what is it doing about it" summary
+        the runbook keys on (docs/degradation.md)."""
+        srv = self.server
+        pol = getattr(srv, "_policy", None) if srv is not None else None
+        adm = getattr(srv, "_admission", None) if srv is not None else None
+        brk = (getattr(self.recursion, "breakers", None)
+               if self.recursion is not None else None)
+        if pol is None and adm is None and brk is None:
+            return None
+        return {
+            "degradation": None if pol is None else pol.introspect(),
+            "admission": None if adm is None else adm.introspect(
+                srv.engine if srv is not None else None),
+            "breakers_open": 0 if brk is None else brk.open_count(),
+        }
 
     def _recorder_section(self) -> Optional[dict]:
         if self.recorder is None:

@@ -109,6 +109,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
             # static per-DC resolver lists may live at recursion.dcs or
             # recursion.ufds.dcs; a real UFDS/LDAP source plugs in here
             ufds=rcfg.get("ufds") or rcfg,
+            # per-peer circuit breakers report binder_breaker_state and
+            # breaker-transition flight events (docs/degradation.md)
+            collector=collector, recorder=recorder,
         )
         await recursion.wait_ready()
 
@@ -151,8 +154,42 @@ async def run(options: Dict[str, object]) -> BinderServer:
         max_tcp_write_buffer=(int(options["maxTcpWriteBuffer"])
                               if "maxTcpWriteBuffer" in options else None),
         flight_recorder=recorder,
+        # graceful degradation + overload shedding (docs/degradation.md):
+        # on by default in production, tunable/disable-able per block
+        # ({"enabled": false} turns one off)
+        degradation=dict(options.get("degradation") or {}),
+        admission=dict(options.get("admission") or {}),
     )
     await server.start()
+
+    # fault injection (chaos) — ONLY when configured, for soaks and the
+    # bench's degraded axis: a scripted FaultPlan drives session loss /
+    # watch storms / loop stalls inside the live process
+    # (binder_tpu/chaos, docs/degradation.md)
+    chaos_cfg = options.get("chaos")
+    if chaos_cfg:
+        from binder_tpu.chaos import ChaosDriver, FaultPlan
+        from binder_tpu.store.cache import domain_to_path
+        plan = FaultPlan.parse(str(chaos_cfg.get("plan", "")),
+                               seed=int(chaos_cfg.get("seed", 0)))
+        domain = str(options["dnsDomain"])
+
+        def chaos_mutate(i: int) -> None:
+            # default watch-storm mutator: churn a small ring of
+            # chaos-owned host records under the served domain
+            store.put_json(
+                domain_to_path(f"chaos{i % 8}.{domain}"),
+                {"type": "host",
+                 "host": {"address": f"10.254.{i % 8}.{i % 250 + 1}"}})
+
+        driver = ChaosDriver(
+            plan, store=store,
+            mutate=chaos_mutate if hasattr(store, "put_json") else None,
+            recorder=recorder, log=log)
+        server.chaos_driver = driver
+        driver.start()
+        log.warning("chaos: FaultPlan armed (%d scheduled action(s), "
+                    "%.1fs)", len(plan.timeline), plan.duration)
 
     # introspection layer: loop-lag watchdog, status endpoint, SIGUSR2
     # flight-recorder dump, balancer stats fold (docs/observability.md)

@@ -1,0 +1,20 @@
+"""Graceful-degradation policy engine (docs/degradation.md).
+
+Three cooperating levers, consuming PR 2's observability substrate:
+
+- :class:`DegradationPolicy` — stale-serve state machine over the
+  store session state (fresh / stale-serving / stale-exhausted),
+  RFC 8767-style TTL clamping and a hard staleness cap;
+- :class:`PeerBreakers` / :class:`CircuitBreaker` — per-upstream
+  circuit breakers with exponential backoff + jitter, half-open
+  probing, and the p95 hedge stagger for recursion forwards;
+- :class:`AdmissionControl` — overload shedding: bounded in-flight
+  table with oldest-shed and per-client token buckets for
+  recursion-triggering queries.
+"""
+from binder_tpu.policy.admission import AdmissionControl
+from binder_tpu.policy.breaker import CircuitBreaker, PeerBreakers
+from binder_tpu.policy.degrade import DegradationPolicy
+
+__all__ = ["AdmissionControl", "CircuitBreaker", "PeerBreakers",
+           "DegradationPolicy"]

@@ -159,10 +159,17 @@ class DnsClient:
 
     def __init__(self, concurrency: int = 2,
                  timeout: float = DEFAULT_TIMEOUT,
-                 log: Optional[logging.Logger] = None) -> None:
+                 log: Optional[logging.Logger] = None,
+                 breakers=None) -> None:
         self.concurrency = concurrency
         self.timeout = timeout
         self.log = log or logging.getLogger("binder.dnsclient")
+        # per-peer circuit breakers + latency stats
+        # (binder_tpu/policy/breaker.py), shared with the owning
+        # Recursion: open peers are skipped before any packet is sent,
+        # and p95 latency drives the hedged-request stagger in
+        # lookup_raw.  None = classic behavior (bare-client tests).
+        self.breakers = breakers
         # (host, port) -> (loop, _PortProto); recreated if the transport
         # died or the entry belongs to a previous event loop (tests run
         # several loops in one process)
@@ -270,94 +277,148 @@ class DnsClient:
         Validation is the id-multiplex + dns0x20 verbatim question echo
         (\\_PortProto) plus the header rcode/tc checks here; body
         structure is checked by whoever consumes the bytes (the splice
-        walker, or Message.decode on the rebuild path).  Tries
-        *resolvers* with at most ``concurrency`` queries in flight;
-        gives up once ``error_threshold`` upstreams have failed
-        (default: all of them, matching mname-client's behavior of
-        walking the whole list).
+        walker, or Message.decode on the rebuild path).  Gives up once
+        ``error_threshold`` upstreams have failed (default: all of
+        them, matching mname-client's behavior of walking the whole
+        list).
+
+        Dispatch is breaker-aware and hedged (the serial-timeout fix):
+        peers whose circuit breaker is open are dropped before any
+        packet moves — an all-open set fails fast with a well-formed
+        error instead of hanging — and after the first ``concurrency``
+        upstreams, each further upstream is launched when a prior one
+        FAILS *or* when the most recent one has been silent past its
+        p95-based hedge delay, whichever is first.  A dead-but-
+        unopened peer therefore costs one hedge stagger (~tens of ms),
+        not the full 3 s timeout the reference pays per dead resolver.
         """
         if not resolvers:
             raise UpstreamError("no upstream resolvers")
+        br = self.breakers
+        if br is not None:
+            usable = br.filter(resolvers)
+            if not usable:
+                raise UpstreamError(
+                    "all upstream breakers open: "
+                    + ", ".join(str(r) for r in resolvers[:4]))
+            resolvers = usable
         threshold = (len(resolvers) if error_threshold is None
-                     else error_threshold)
+                     else min(error_threshold, len(resolvers)))
 
         if len(resolvers) == 1:
             # single upstream (the common cross-DC forward): skip the
-            # semaphore/task fan-out machinery entirely
+            # task fan-out machinery entirely
             return await self._lookup_one_raw(name, qtype, resolvers[0])
 
-        sem = asyncio.Semaphore(self.concurrency)
         errors: List[str] = []
         done_count = [0]
-        winner: asyncio.Future = asyncio.get_running_loop().create_future()
+        started = [0]
+        loop = asyncio.get_running_loop()
+        winner: asyncio.Future = loop.create_future()
+        progress = asyncio.Event()   # set on every per-resolver failure
 
         async def one(resolver: str) -> None:
             try:
-                async with sem:
-                    if winner.done():
-                        return
-                    try:
-                        raw = await self._query_one(name, qtype, resolver)
-                    except Exception as e:  # noqa: BLE001 — any failure
-                        # counts against the threshold; an uncounted error
-                        # (e.g. a malformed resolver string) would hang
-                        # the lookup forever
-                        errors.append(f"{resolver}: {e}")
-                    else:
-                        rcode = raw[3] & 0x0F
-                        tc = bool(raw[2] & 0x02)
-                        if rcode == Rcode.NOERROR and tc:
-                            # truncated: retry the same resolver over
-                            # TCP before counting it as a failure
-                            # (mname-client capability the reference
-                            # relies on for large PTR/SRV answer sets,
-                            # lib/recursion.js:253-279)
-                            try:
-                                raw = await self._query_one_tcp(
-                                    name, qtype, resolver)
-                                rcode = raw[3] & 0x0F
-                                tc = bool(raw[2] & 0x02)
-                            except Exception as e:  # noqa: BLE001
-                                errors.append(
-                                    f"{resolver}: tcp retry: {e}")
-                                raw = None
-                        if (raw is not None
-                                and rcode == Rcode.NOERROR and not tc):
-                            # full decode before the response can win
-                            # the fan-out race: a body-malformed NOERROR
-                            # must count as ONE resolver error and let
-                            # another upstream win, not fail the lookup.
-                            # (The single-upstream fast path skips this
-                            # — with no alternative upstream, a decode
-                            # failure ends the same way either side.)
-                            ok = wire_walks(raw)
-                            if ok:
-                                try:
-                                    Message.decode(raw)
-                                except Exception:  # noqa: BLE001
-                                    ok = False
-                            if ok:
-                                if not winner.done():
-                                    winner.set_result(raw)
-                                return
-                            errors.append(f"{resolver}: malformed body")
-                            raw = None
-                        if raw is not None:
+                if winner.done():
+                    return
+                try:
+                    raw = await self._query_one(name, qtype, resolver)
+                except Exception as e:  # noqa: BLE001 — any failure
+                    # counts against the threshold; an uncounted error
+                    # (e.g. a malformed resolver string) would hang
+                    # the lookup forever
+                    errors.append(f"{resolver}: {e}")
+                    progress.set()
+                else:
+                    rcode = raw[3] & 0x0F
+                    tc = bool(raw[2] & 0x02)
+                    if rcode == Rcode.NOERROR and tc:
+                        # truncated: retry the same resolver over
+                        # TCP before counting it as a failure
+                        # (mname-client capability the reference
+                        # relies on for large PTR/SRV answer sets,
+                        # lib/recursion.js:253-279)
+                        try:
+                            raw = await self._query_one_tcp(
+                                name, qtype, resolver)
+                            rcode = raw[3] & 0x0F
+                            tc = bool(raw[2] & 0x02)
+                        except Exception as e:  # noqa: BLE001
                             errors.append(
-                                f"{resolver}: "
-                                + ("truncated" if tc
-                                   else f"rcode {Rcode.name(rcode)}"))
-                    if len(errors) >= threshold and not winner.done():
-                        winner.set_exception(UpstreamError(
-                            "; ".join(errors[-4:])))
+                                f"{resolver}: tcp retry: {e}")
+                            progress.set()
+                            raw = None
+                    if (raw is not None
+                            and rcode == Rcode.NOERROR and not tc):
+                        # full decode before the response can win
+                        # the fan-out race: a body-malformed NOERROR
+                        # must count as ONE resolver error and let
+                        # another upstream win, not fail the lookup.
+                        # (The single-upstream fast path skips this
+                        # — with no alternative upstream, a decode
+                        # failure ends the same way either side.)
+                        ok = wire_walks(raw)
+                        if ok:
+                            try:
+                                Message.decode(raw)
+                            except Exception:  # noqa: BLE001
+                                ok = False
+                        if ok:
+                            if not winner.done():
+                                winner.set_result(raw)
+                            return
+                        errors.append(f"{resolver}: malformed body")
+                        progress.set()
+                        raw = None
+                    if raw is not None:
+                        errors.append(
+                            f"{resolver}: "
+                            + ("truncated" if tc
+                               else f"rcode {Rcode.name(rcode)}"))
+                        progress.set()
+                if len(errors) >= threshold and not winner.done():
+                    winner.set_exception(UpstreamError(
+                        "; ".join(errors[-4:])))
             finally:
                 done_count[0] += 1
-                if done_count[0] == len(resolvers) and not winner.done():
+                if (done_count[0] == len(resolvers)
+                        and not winner.done()):
                     winner.set_exception(UpstreamError(
                         "; ".join(errors[-4:]) or "all upstreams failed"))
 
-        tasks = [asyncio.ensure_future(one(r)) for r in resolvers]
+        burst = min(self.concurrency, len(resolvers))
+        tasks = [asyncio.ensure_future(one(r))
+                 for r in resolvers[:burst]]
+        started[0] = burst
+        errors_consumed = 0
         try:
+            while started[0] < len(resolvers) and not winner.done():
+                progress.clear()
+                if len(errors) > errors_consumed:
+                    # a prior upstream failed: launch the next one NOW
+                    errors_consumed += 1
+                else:
+                    # hedge: give the most recently launched upstream
+                    # its p95 (+headroom) to answer, then stop waiting
+                    # for it alone.  No synchronization races here:
+                    # failures only land during awaits, and the
+                    # clear-check-wait sequence has none between them.
+                    hedge = (br.hedge_delay(resolvers[started[0] - 1])
+                             if br is not None else None)
+                    waiter = asyncio.ensure_future(progress.wait())
+                    try:
+                        await asyncio.wait(
+                            [winner, waiter], timeout=hedge,
+                            return_when=asyncio.FIRST_COMPLETED)
+                    finally:
+                        waiter.cancel()
+                    if winner.done():
+                        break
+                    if len(errors) > errors_consumed:
+                        errors_consumed += 1
+                tasks.append(asyncio.ensure_future(
+                    one(resolvers[started[0]])))
+                started[0] += 1
             return await winner
         finally:
             for t in tasks:
@@ -444,15 +505,51 @@ class DnsClient:
         fut: asyncio.Future = loop.create_future()
         proto.pending[qid] = (fut, expect_q, loop.time() + self.timeout)
         proto._arm_sweep(loop, min(self.timeout / 2, 0.25))
+        if self.breakers is not None:
+            # Breaker feedback rides the FUTURE, not this coroutine: a
+            # hedged lookup cancels the losers' driver tasks the moment
+            # a winner lands, but the losers' datagrams are still in
+            # flight — their true outcome (response vs deadline-sweep
+            # timeout) settles the future later, and THAT is what the
+            # breaker must see, or a dead peer racing a healthy one
+            # would never accumulate the failures that open its
+            # breaker.  The pending entry is deliberately left in place
+            # on cancellation below; the sweep (or a late response)
+            # always settles and removes it within one timeout.
+            sent_at = loop.time()
+
+            def _outcome(f: "asyncio.Future",
+                         resolver=resolver, sent_at=sent_at) -> None:
+                if f.cancelled():
+                    return      # outcome unknown: no evidence either way
+                if f.exception() is not None:
+                    self.breakers.record(resolver, False)
+                else:
+                    recv_t = getattr(f, "binder_recv_t", None)
+                    self.breakers.record(
+                        resolver, True,
+                        (recv_t if recv_t is not None else loop.time())
+                        - sent_at)
+
+            fut.add_done_callback(_outcome)
         try:
             proto.transport.sendto(wire)
-            return await fut
+            if self.breakers is None:
+                return await fut
+            # shield: a hedged lookup cancels losing driver TASKS, and
+            # a task awaiting a bare future cancels the future with it
+            # — which would erase the in-flight query's real outcome.
+            # Shielded, the wire future lives on; the deadline sweep
+            # (or a late response) settles it, _outcome above records
+            # the truth, and the settling path removes the pending
+            # entry.
+            return await asyncio.shield(fut)
         finally:
-            # pop only our own entry: after this qid was released (answer
-            # delivered / socket failed), another query may have re-used
-            # it before this finally ran
+            # pop only our own SETTLED entry: after this qid was
+            # released (answer delivered / socket failed), another
+            # query may have re-used it before this finally ran
             cur = proto.pending.get(qid)
-            if cur is not None and cur[0] is fut:
+            if cur is not None and cur[0] is fut and fut.done():
                 del proto.pending[qid]
 
     async def _query_one_tcp(self, name: str, qtype: int,

@@ -102,12 +102,23 @@ class Recursion:
                  log: Optional[logging.Logger] = None,
                  nic_provider=netif.local_addresses,
                  client: Optional[DnsClient] = None,
-                 ptr_client: Optional[DnsClient] = None) -> None:
+                 ptr_client: Optional[DnsClient] = None,
+                 breakers=None, collector=None, recorder=None) -> None:
         self.zk_cache = zk_cache
         self.dns_domain = dns_domain.lower()
         self.datacenter_name = datacenter_name
         self.region_name = region_name
         self.log = log or logging.getLogger("binder.recursion")
+        # Per-peer circuit breakers (binder_tpu/policy/breaker.py),
+        # shared by BOTH clients so a peer's health is one fact.  On by
+        # default: a dead remote binder must cost a hedge stagger, not
+        # the full serial timeout, and once its breaker is open it
+        # costs nothing at all (docs/degradation.md).
+        if breakers is None:
+            from binder_tpu.policy.breaker import PeerBreakers
+            breakers = PeerBreakers(collector=collector,
+                                    recorder=recorder, log=self.log)
+        self.breakers = breakers
         if source is None:
             if ufds is not None and "dcs" in (ufds or {}):
                 source = StaticResolverSource(ufds["dcs"])
@@ -120,9 +131,15 @@ class Recursion:
                 source = StaticResolverSource({})
         self.source = source
         self.nic_provider = nic_provider
-        self.nsc = client or DnsClient(concurrency=2)
+        self.nsc = client or DnsClient(concurrency=2, breakers=breakers)
         # PTR fans out to every binder in parallel (lib/recursion.js:67-78)
-        self.nsc_max = ptr_client or DnsClient(concurrency=PTR_CONCURRENCY)
+        self.nsc_max = ptr_client or DnsClient(concurrency=PTR_CONCURRENCY,
+                                               breakers=breakers)
+        # injected clients (tests) still get the shared breaker registry
+        # unless they brought their own
+        for c in (self.nsc, self.nsc_max):
+            if c.breakers is None:
+                c.breakers = breakers
 
         self.dcs: Dict[str, List[str]] = {}
         # monotonic instant of the last successful resolver-discovery
@@ -240,6 +257,10 @@ class Recursion:
             # 0x20-incompatible peer
             "case_mismatch_drops": (self.nsc.case_mismatch_drops()
                                     + self.nsc_max.case_mismatch_drops()),
+            # per-peer circuit breakers (docs/degradation.md): state,
+            # failure runs, backoff, and the p95 behind the hedge delay
+            "breakers": self.breakers.introspect(),
+            "breakers_open": self.breakers.open_count(),
         }
 
     # -- the resolve path (lib/recursion.js:287-388) --
@@ -261,6 +282,10 @@ class Recursion:
         engine's after hook), returning ``HANDLED_ASYNC``.  Everything
         else (PTR fan-out, multi-upstream DCs, cold ports, truncation
         retries) returns the coroutine the engine drives as a task."""
+        # we ARE the recursive service for this shape: RA set on every
+        # recursion-produced response, success or failure (the splice
+        # path patches the same bit into forwarded wire)
+        query.response.ra = True
         if self.engine_after is not None and query.qtype() != Type.PTR:
             domain = query.name().lower()
             if domain.endswith(self.dns_domain):
@@ -268,7 +293,10 @@ class Recursion:
                 dc = prefix[prefix.rfind(".") + 1:]
                 ups = self.dcs.get(dc)
                 if ups is not None and len(ups) == 1 \
-                        and _host_of(ups[0]) not in self._my_addrs():
+                        and _host_of(ups[0]) not in self._my_addrs() \
+                        and self.breakers.get(ups[0]).state == "closed":
+                    # (non-closed breaker: the slow path owns the
+                    # skip/probe/fail-fast policy via lookup_raw)
                     sent_at = time.monotonic()
                     fut = self.nsc.query_future(domain, query.qtype(),
                                                 ups[0])
@@ -278,13 +306,14 @@ class Recursion:
                         query.stamp("dispatch")
                         fut.add_done_callback(
                             lambda f: self._complete(query, domain, f,
-                                                     sent_at))
+                                                     sent_at, ups[0]))
                         return HANDLED_ASYNC
         return self._resolve_slow(query)
 
     def _complete(self, query: QueryCtx, domain: str,
                   fut: "asyncio.Future",
-                  sent_at: Optional[float] = None) -> None:
+                  sent_at: Optional[float] = None,
+                  upstream: Optional[str] = None) -> None:
         """Future callback finishing a fast-path forward: splice the
         validated upstream wire, or decode+rebuild for shapes the
         splice declines, or REFUSED on upstream failure — then run the
@@ -307,6 +336,15 @@ class Recursion:
         try:
             exc = fut.exception()
             raw_up = None if exc is not None else fut.result()
+            if upstream is not None:
+                # breaker feedback for the zero-coroutine path (the
+                # coroutine paths record inside _query_one): a response
+                # of any rcode is a live peer; an exception (timeout,
+                # socket death) is a transport failure
+                self.breakers.record(
+                    upstream, raw_up is not None,
+                    None if recv_t is None or sent_at is None
+                    else recv_t - sent_at)
             if raw_up is not None:
                 rcode = raw_up[3] & 0x0F
                 if raw_up[2] & 0x02 and rcode == Rcode.NOERROR:
@@ -517,9 +555,12 @@ class Recursion:
             tail = up[q_end:]
             new_ar = arcount
         # header: client id, upstream flags with the client's RD echoed
-        # (we forward with RD=0), counts with the OPT adjustment
+        # (we forward with RD=0), RA set — WE are the recursive service
+        # here; the upstream answered authoritatively with its own RA
+        # clear — and counts with the OPT adjustment
         flags2 = (up[2] & 0xFE) | (0x01 if req.rd else 0)
-        wire = (req.id.to_bytes(2, "big") + bytes((flags2, up[3]))
+        wire = (req.id.to_bytes(2, "big")
+                + bytes((flags2, up[3] | 0x80))
                 + up[4:10] + new_ar.to_bytes(2, "big")
                 + raw[12:q_end] + tail)
         if query.udp_semantics and len(wire) > req.max_udp_payload():

@@ -104,7 +104,7 @@ class AnswerPlan:
     """
 
     __slots__ = ("rcode", "groups", "authorities", "rotatable",
-                 "dep_domain", "miss", "reason", "log_query")
+                 "dep_domain", "miss", "reason", "log_query", "stale")
 
     def __init__(self) -> None:
         self.rcode = Rcode.NOERROR
@@ -117,6 +117,9 @@ class AnswerPlan:
         self.miss = False
         self.reason: Optional[str] = None      # log_ctx["reason"]
         self.log_query: Optional[dict] = None  # log_ctx["query"]
+        #: resolved from a stale mirror (degradation policy: session
+        #: down, within maxStalenessSeconds, TTLs clamped)
+        self.stale = False
 
     @property
     def negative(self) -> bool:
@@ -142,6 +145,13 @@ class Resolver:
         self.recursion = recursion
         self.log = log or logging.getLogger("binder.resolver")
         self.rng = rng or random.Random()
+        # degradation policy engine hooks, assigned by BinderServer
+        # (binder_tpu/policy): `policy` gates stale serving (TTL clamp /
+        # withhold past the cap), `admission` rate-limits the
+        # recursion-triggering shape per client.  None = classic
+        # behavior (serve the mirror forever, forward every miss).
+        self.policy = None
+        self.admission = None
 
     # -- entry point used by the server engine (lib/server.js:491-506) --
     #
@@ -223,6 +233,15 @@ class Resolver:
             p.rcode = Rcode.REFUSED
             return p
 
+        # degradation gate (docs/degradation.md): past the staleness
+        # cap the mirror's data may no longer be served at all; within
+        # it, answers flow with clamped TTLs (_apply_stale at the
+        # positive returns below)
+        mode = self._policy_mode()
+        if mode == "stale-exhausted":
+            return self._withhold(p, domain)
+        stale = mode == "stale-serving"
+
         # dependency tag for the answer caches: whatever this lookup
         # yields (including a miss-REFUSED) changes when `domain`
         # mutates in the store — note for SRV this is the *service node*
@@ -252,7 +271,7 @@ class Resolver:
             # caching (lib/server.js:276-292)
             p.authorities.append(SOARecord(
                 name=domain, ttl=ttl, mname=self.dns_domain, minimum=ttl))
-            return p
+            return self._apply_stale(p, stale)
 
         rtype = record["type"]
         if rtype == "database":
@@ -268,6 +287,47 @@ class Resolver:
                                service, protocol, ttl)
         else:
             self.log.error("record type %r in store is unknown", rtype)
+        return self._apply_stale(p, stale)
+
+    # -- degradation-policy plumbing (binder_tpu/policy/degrade.py) --
+
+    def _policy_mode(self) -> str:
+        return "fresh" if self.policy is None else self.policy.mode()
+
+    def _withhold(self, p: AnswerPlan, domain: str) -> AnswerPlan:
+        """The stale-exhausted shape: the mirror is older than
+        maxStalenessSeconds and its data may not be served.  Per
+        config: SERVFAIL (clients fail over, the engine's standing
+        policy for a broken store) or NODATA + SOA (negative-cacheable
+        at the clamp TTL)."""
+        pol = self.policy
+        pol.note_withheld()
+        p.reason = "stale beyond maxStalenessSeconds"
+        p.dep_domain = domain
+        if pol.exhausted_action == "nodata":
+            ttl = pol.stale_ttl_clamp_s
+            p.authorities.append(SOARecord(
+                name=domain, ttl=ttl, mname=self.dns_domain,
+                minimum=ttl))
+        else:
+            p.rcode = Rcode.SERVFAIL
+        return p
+
+    def _apply_stale(self, p: AnswerPlan, stale: bool) -> AnswerPlan:
+        """Mark and TTL-clamp a plan resolved from a stale mirror
+        (RFC 8767 §5: low TTLs so clients re-ask and converge fast
+        after recovery)."""
+        if stale:
+            clamp = self.policy.stale_ttl_clamp_s
+            for answers, additionals in p.groups:
+                for rec in answers:
+                    rec.ttl = min(rec.ttl, clamp)
+                for rec in additionals:
+                    rec.ttl = min(rec.ttl, clamp)
+            for rec in p.authorities:
+                rec.ttl = min(rec.ttl, clamp)
+            p.stale = True
+            self.policy.note_stale_served()
         return p
 
     def _plan_service(self, p: AnswerPlan, node, record: dict, qname: str,
@@ -335,9 +395,21 @@ class Resolver:
             query.log_ctx["reason"] = plan.reason
         if plan.dep_domain is not None:
             query.dep_domain = plan.dep_domain
+        if plan.stale:
+            query.log_ctx["stale"] = True
         # decode→policy→mirror probe→plan, on the attribution timeline
         query.stamp("store-lookup")
         if plan.miss and self.recursion is not None and query.rd():
+            adm = self.admission
+            if adm is not None and not adm.allow_recursion(query.src[0]):
+                # recursion-triggering floods are shed per client
+                # BEFORE any upstream work (docs/degradation.md):
+                # well-formed REFUSED, clients fail over
+                query.set_error(Rcode.REFUSED)
+                query.log_ctx["reason"] = "recursion rate limit"
+                query.stamp("pre-resp")
+                query.respond()
+                return None
             # recursion answers belong to another DC's store — no
             # cache layer may keep them (query.no_store reaches the
             # balancer as the do-not-store transport marker)
@@ -385,6 +457,11 @@ class Resolver:
 
         p.log_query = {"ip": ip, "type": Type.name(Type.PTR)}
 
+        # degradation gate, same policy as the forward tree
+        mode = self._policy_mode()
+        if mode == "stale-exhausted":
+            return self._withhold(p, qname.lower())
+
         # dependency tag: mutations touching this address emit the
         # normalized reverse qname (store/cache.py _rev_name)
         p.dep_domain = qname.lower()
@@ -400,4 +477,4 @@ class Resolver:
         ttl = _record_ttl(record, sub if isinstance(sub, dict) else {})
         p.groups.append(([PTRRecord(name=qname, ttl=ttl,
                                     target=node.domain)], []))
-        return p
+        return self._apply_stale(p, mode == "stale-serving")

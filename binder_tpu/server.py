@@ -158,7 +158,9 @@ class BinderServer:
                  max_tcp_conns: Optional[int] = None,
                  max_tcp_write_buffer: Optional[int] = None,
                  probes: Optional[ProbeProvider] = None,
-                 flight_recorder=None) -> None:
+                 flight_recorder=None,
+                 degradation: Optional[dict] = None,
+                 admission: Optional[dict] = None) -> None:
         self.log = log or logging.getLogger("binder.server")
         # introspection flight recorder (binder_tpu/introspect):
         # slow-query events from the after hook and lane, resolver
@@ -214,6 +216,44 @@ class BinderServer:
         self.resolver = Resolver(zk_cache, dns_domain=dns_domain,
                                  datacenter_name=datacenter_name,
                                  recursion=recursion, log=self.log)
+
+        # Degradation policy engine (binder_tpu/policy, docs/
+        # degradation.md).  Off by default at this layer — main.py
+        # turns both on from config (`degradation` / `admission`
+        # blocks, default enabled) like the other production knobs.
+        self._policy = None
+        self._policy_task = None
+        store = getattr(zk_cache, "store", None)
+        if (degradation is not None
+                and degradation.get("enabled", True) and store is not None):
+            from binder_tpu.policy import DegradationPolicy
+            self._policy = DegradationPolicy(
+                store=store, zk_cache=zk_cache,
+                max_staleness_s=float(degradation.get(
+                    "maxStalenessSeconds", 300.0)),
+                stale_ttl_clamp_s=int(degradation.get(
+                    "staleTtlClampSeconds", 30)),
+                exhausted_action=str(degradation.get(
+                    "exhaustedAction", "servfail")),
+                collector=self.collector, recorder=flight_recorder,
+                log=self.log)
+            # answers rendered under one staleness mode must never be
+            # served under another: every transition flushes all cached
+            # lanes (Python, compiled, native, balancer) via the epoch
+            self._policy.on_transition(self._on_degradation_transition)
+            self.resolver.policy = self._policy
+        self._admission = None
+        if admission is not None and admission.get("enabled", True):
+            from binder_tpu.policy import AdmissionControl
+            self._admission = AdmissionControl(
+                max_inflight=int(admission.get("maxInflight", 512)),
+                recursion_rate=float(admission.get(
+                    "recursionRate", 50.0)),
+                recursion_burst=float(admission.get(
+                    "recursionBurst", 100.0)),
+                collector=self.collector, recorder=flight_recorder,
+                log=self.log)
+            self.resolver.admission = self._admission
         if recursion is not None and hasattr(recursion, "engine_after"):
             # arm the recursion fast path: its future callback completes
             # the query AND runs the engine's after hook itself
@@ -245,6 +285,7 @@ class BinderServer:
         self.engine.on_query = self._on_query
         self.engine.on_after = self._on_after
         self.engine.recorder = flight_recorder
+        self.engine.admission = self._admission
         # the engine's cap-refusal log line is rate-limited, so the
         # counter is the only complete record — surface it in the scrape
         self._cap_refusal_child = self.collector.counter(
@@ -272,7 +313,7 @@ class BinderServer:
         # ordinary mutations ride the per-name invalidate frames
         # broadcast from _on_store_invalidate
         # (docs/balancer-protocol.md control frames)
-        self.engine.gen_source = lambda: self.zk_cache.epoch
+        self.engine.gen_source = self._epoch_source
         if hasattr(zk_cache, "on_mutation"):
             zk_cache.on_mutation(self.engine.notify_mutation)
         # Per-name invalidation: a mirrored mutation drops exactly the
@@ -294,7 +335,7 @@ class BinderServer:
                 [float(b) for b in self.latency_histogram.buckets],
                 [float(b) for b in self.size_histogram.buckets])
             self.engine.fastpath = self._fastpath
-            self.engine.fastpath_gen = lambda: self.zk_cache.epoch
+            self.engine.fastpath_gen = self._epoch_source
             self.engine.fastpath_gate = self._fastpath_active
             self.collector.on_expose(self._fold_fastpath_metrics)
 
@@ -386,6 +427,37 @@ class BinderServer:
         _after call."""
         self.engine._after(query)
 
+    def _epoch_source(self) -> int:
+        """The epoch every cached lane validates against — evaluated
+        THROUGH the degradation policy, so a lazy state transition
+        (and its epoch-bumping cache flush) lands before the epoch is
+        read.  Without this ordering, the first post-session-loss
+        query could serve an unclamped cached wire from the native
+        drain before any Python path noticed the transition."""
+        if self._policy is not None:
+            self._policy.mode()
+        return self.zk_cache.epoch
+
+    def _on_degradation_transition(self, old: str, new: str) -> None:
+        """Degradation state edge: flush every cached answer lane.  The
+        epoch bump invalidates the Python answer cache, the compiled
+        table, the native C caches, and (via the generation frame) the
+        balancer — so a wire rendered fresh is never served into
+        exhaustion and clamped-TTL stale wires never survive recovery."""
+        self.zk_cache.invalidate_all(
+            reason=f"degradation {old} -> {new}")
+
+    async def _policy_tick_loop(self) -> None:
+        """1 s degradation-policy evaluator: transitions (and their
+        metrics / flight-recorder events) must fire on an idle binder
+        too, not only when a query happens to ask."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._policy.tick()
+            except Exception:
+                self.log.exception("degradation policy tick failed")
+
     # -- query hook (lib/server.js:471-507); sync, may return an awaitable
     # for the recursion path (see DnsServer._dispatch) --
 
@@ -414,7 +486,9 @@ class BinderServer:
             q0 = req.questions[0]
             key = (query.udp_semantics, req.rd, q0.qtype, q0.qclass,
                    q0.name, req.edns is not None, req.max_udp_payload())
-            cached = self.answer_cache.get(key, self.zk_cache.epoch)
+            # policy-aware epoch: a pending degradation transition must
+            # flush the caches BEFORE this probe can hit
+            cached = self.answer_cache.get(key, self._epoch_source())
             if cached is not None:
                 wire, ans, add = cached
                 self._cache_hit_child.inc()
@@ -1165,6 +1239,11 @@ class BinderServer:
         if (self.query_log or self.p_req_start.enabled
                 or self.p_req_done.enabled):
             return False
+        if self._policy is not None and self._policy.mode() != "fresh":
+            # degraded serving (TTL clamp, withhold-past-cap) is the
+            # generic path's job; the lane declines rather than
+            # duplicating the policy matrix (docs/degradation.md)
+            return False
         dd_suffix = self._lane_suffix
         if dd_suffix is None:
             return False
@@ -1783,8 +1862,18 @@ class BinderServer:
             # their own (TCP/balancer serves) and for idle tails
             self._log_flush_task = asyncio.get_running_loop().create_task(
                 self._log_flush_loop())
+        if self._policy is not None and self._policy_task is None:
+            self._policy_task = asyncio.get_running_loop().create_task(
+                self._policy_tick_loop())
 
     async def stop(self) -> None:
+        if self._policy_task is not None:
+            self._policy_task.cancel()
+            try:
+                await self._policy_task
+            except asyncio.CancelledError:
+                pass
+            self._policy_task = None
         if self._log_flush_task is not None:
             self._log_flush_task.cancel()
             try:

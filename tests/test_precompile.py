@@ -490,3 +490,68 @@ class TestMetrics:
         assert pc["compiled"] >= 1
         assert pc["queue_depth"] == 0
         assert pc["max_pending"] > 0
+
+
+class TestSessionFlapSoak:
+    """ZK session *flapping* (ISSUE 4 satellite): rapid
+    connected -> degraded -> connected cycles while names churn must
+    not leak precompile work — every cycle's queue drains back to
+    empty, shed work is bounded by MAX_PENDING, and the compiled table
+    still serves the final state."""
+
+    def test_flap_cycles_leave_no_queue_leak(self):
+        async def run():
+            store, cache, server = build()
+            pc = server._precompiler
+            for i in range(12):
+                put_host(store, f"/com/foo/f{i}", f"10.4.0.{i + 1}")
+                ask(server, f"f{i}.foo.com", Type.A, qid=i + 1)
+            await asyncio.sleep(0)
+            for cycle in range(8):
+                store.lose_session()
+                # mutations while dark are not mirrored (no watch
+                # events) — nothing may enqueue
+                depth_dark = len(pc._pending)
+                store.start_session()     # rebind storms the watchers
+                for i in range(12):
+                    put_host(store, f"/com/foo/f{i}",
+                             f"10.5.{cycle}.{i + 1}")
+                assert len(pc._pending) <= pc.MAX_PENDING
+                # drain completely between flaps: a leak would show as
+                # monotonic queue growth across cycles
+                for _ in range(1000):
+                    if not pc._pending:
+                        break
+                    await asyncio.sleep(0)
+                assert not pc._pending, \
+                    f"queue leaked {len(pc._pending)} items " \
+                    f"(cycle {cycle}, dark depth {depth_dark})"
+            # post-flap: the final addresses serve (precompiled or
+            # lazily — correctness first), and the queue is at rest
+            r, _, q = ask(server, "f11.foo.com", Type.A, qid=99)
+            assert r.rcode == Rcode.NOERROR
+            assert [a.address for a in r.answers] == ["10.5.7.12"]
+            assert pc.introspect()["queue_depth"] == 0
+
+        asyncio.run(run())
+
+    def test_flap_with_expire_session_keeps_read_your_writes(self):
+        async def run():
+            store, cache, server = build()
+            pc = server._precompiler
+            put_host(store, "/com/foo/flap", "10.6.0.1")
+            ask(server, "flap.foo.com", Type.A, qid=1)
+            for cycle in range(6):
+                store.expire_session()   # loss + immediate re-establish
+                put_host(store, "/com/foo/flap", f"10.6.0.{cycle + 2}")
+                for _ in range(1000):
+                    if not pc._pending:
+                        break
+                    await asyncio.sleep(0)
+                r, _, _q = ask(server, "flap.foo.com", Type.A,
+                               qid=cycle + 10)
+                assert [a.address for a in r.answers] \
+                    == [f"10.6.0.{cycle + 2}"]
+            assert not pc._pending
+
+        asyncio.run(run())

@@ -58,7 +58,9 @@ async def start_local(dcs, server_kw=None, **rkw):
     return server, recursion
 
 
-async def udp_ask(port, name, qtype, rd=True, timeout=5.0, payload=1232):
+async def udp_ask_wire(port, name, qtype, rd=True, timeout=5.0,
+                       payload=1232):
+    """Ask and return the RAW response wire (flag-level conformance)."""
     loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
@@ -77,7 +79,12 @@ async def udp_ask(port, name, qtype, rd=True, timeout=5.0, payload=1232):
         data = await asyncio.wait_for(fut, timeout)
     finally:
         transport.close()
-    return Message.decode(data)
+    return data
+
+
+async def udp_ask(port, name, qtype, rd=True, timeout=5.0, payload=1232):
+    return Message.decode(await udp_ask_wire(
+        port, name, qtype, rd=rd, timeout=timeout, payload=payload))
 
 
 class TestForwarding:
@@ -633,6 +640,96 @@ class TestRawSplice:
             finally:
                 await rebuilt.stop()
                 await spliced.stop()
+                await r1.close()
+                await r2.close()
+                await remote.stop()
+
+        asyncio.run(run())
+
+
+class TestErrorRenderConformance:
+    """Wire-level conformance for recursion-path error responses
+    (ISSUE 4 satellite): a SERVFAIL/REFUSED produced on the recursion
+    path must carry the query's EDNS posture (the OPT echo survives
+    the error path's section reset) and set RA — this binder IS the
+    recursive service for the shape it just failed to recurse."""
+
+    RA_BIT = 0x80
+
+    def test_handler_crash_servfail_keeps_edns_and_ra(self):
+        async def run():
+            server, recursion = await start_local(
+                {"east": ["127.0.0.1:9"]})
+
+            async def boom(query):
+                raise RuntimeError("injected recursion failure")
+
+            # the coroutine path raises -> engine _on_query_error
+            recursion._resolve_slow = boom
+            try:
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+                assert raw[3] & 0x0F == Rcode.SERVFAIL
+                assert raw[3] & self.RA_BIT, "RA must be set"
+                msg = Message.decode(raw)
+                assert msg.additionals and \
+                    msg.additionals[-1].rtype == Type.OPT, \
+                    "SERVFAIL must echo the EDNS OPT"
+                # and WITHOUT EDNS on the query: no OPT invented
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A,
+                                         payload=None)
+                assert raw[3] & 0x0F == Rcode.SERVFAIL
+                assert Message.decode(raw).additionals == []
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        asyncio.run(run())
+
+    def test_upstream_failure_refused_keeps_edns_and_ra(self):
+        async def run():
+            from binder_tpu.recursion import DnsClient
+            server, recursion = await start_local(
+                {"east": ["127.0.0.1:9"]},
+                client=DnsClient(concurrency=2, timeout=0.2))
+            try:
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+                assert raw[3] & 0x0F == Rcode.REFUSED
+                assert raw[3] & self.RA_BIT, "RA must be set"
+                msg = Message.decode(raw)
+                assert msg.additionals and \
+                    msg.additionals[-1].rtype == Type.OPT
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        asyncio.run(run())
+
+    def test_success_paths_set_ra_spliced_and_rebuilt(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.3")
+            # query_log=True forces the rebuild path; default splices
+            rebuilt_srv, r1 = await start_local(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                server_kw={"query_log": True})
+            spliced_srv, r2 = await start_local(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            try:
+                for srv in (rebuilt_srv, spliced_srv):
+                    raw = await udp_ask_wire(srv.udp_port,
+                                             "web.east.foo.com", Type.A)
+                    assert raw[3] & 0x0F == Rcode.NOERROR
+                    assert raw[3] & self.RA_BIT, "RA must be set"
+                # a locally served (non-recursion) answer does NOT
+                # advertise recursion
+                raw = await udp_ask_wire(remote.udp_port,
+                                         "web.east.foo.com", Type.A)
+                assert not raw[3] & self.RA_BIT
+            finally:
+                await rebuilt_srv.stop()
+                await spliced_srv.stop()
                 await r1.close()
                 await r2.close()
                 await remote.stop()

@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+"""Scripted-FaultPlan smoke: the degradation policy engine, end to end.
+
+Boots a full in-process binder (fake store + recursion to a chaos
+upstream + degradation/admission policy), runs a scripted FaultPlan —
+upstream packet loss, ZK session loss mid-churn, a watch storm, an
+event-loop stall, then recovery — while driving continuous queries,
+and asserts the PR's acceptance invariants:
+
+- every query gets a well-formed answer or refusal (never a hang);
+- data answers are served only while fresh or within
+  ``maxStalenessSeconds`` (stale answers TTL-clamped);
+- past the cap answers are withheld (SERVFAIL), never stale;
+- after the faults heal, the system re-converges: mirror generation
+  advances, ``binder_degraded_state`` returns to 0, breakers close;
+- the scrape passes ``validate_degradation_metrics`` and the status
+  snapshot passes ``validate_status_snapshot`` mid-incident.
+
+Run via ``make chaos-smoke`` (30 s) or set ``BINDER_CHAOS_SECONDS``.
+Prints one JSON summary line; exit 0 == all invariants held.
+"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.chaos import ChaosDriver, ChaosUpstream, FaultPlan  # noqa: E402
+from binder_tpu.dns import Message, Rcode, Type, make_query  # noqa: E402
+from binder_tpu.introspect import FlightRecorder, Introspector  # noqa: E402
+from binder_tpu.metrics.collector import MetricsCollector  # noqa: E402
+from binder_tpu.recursion import Recursion, StaticResolverSource  # noqa: E402
+from binder_tpu.recursion.client import DnsClient  # noqa: E402
+from binder_tpu.server import BinderServer  # noqa: E402
+from binder_tpu.store import FakeStore, MirrorCache  # noqa: E402
+from tools.lint import (validate_degradation_metrics,  # noqa: E402
+                        validate_status_snapshot)
+
+DOMAIN = "chaos.test"
+
+
+class Violation(Exception):
+    pass
+
+
+async def _ask(port, name, qtype, qid, rd=False, timeout=1.0):
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    class Proto(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            transport.sendto(make_query(name, qtype, qid=qid,
+                                        rd=rd).encode())
+
+        def datagram_received(self, data, addr):
+            if not fut.done():
+                fut.set_result(data)
+
+    transport, _ = await loop.create_datagram_endpoint(
+        Proto, remote_addr=("127.0.0.1", port))
+    try:
+        return Message.decode(await asyncio.wait_for(fut, timeout))
+    finally:
+        transport.close()
+
+
+async def _run(duration: float) -> dict:
+    collector = MetricsCollector()
+    recorder = FlightRecorder(capacity=1024)
+    store = FakeStore(recorder=recorder)
+    cache = MirrorCache(store, DOMAIN, collector=collector,
+                        recorder=recorder)
+    for i in range(8):
+        store.put_json(f"/test/chaos/w{i}",
+                       {"type": "host",
+                        "host": {"address": f"10.0.2.{i + 1}"}})
+    store.start_session()
+
+    up_plan = FaultPlan(seed=11)
+    upstream = ChaosUpstream(up_plan,
+                             hosts={f"w.remote.{DOMAIN}": "10.9.9.9"})
+    up_port = await upstream.start()
+    recursion = Recursion(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="dc0",
+        source=StaticResolverSource({"remote": [f"127.0.0.1:{up_port}"]}),
+        nic_provider=lambda: [],
+        client=DnsClient(timeout=0.25),
+        collector=collector, recorder=recorder)
+    await recursion.wait_ready()
+
+    max_staleness = duration * 0.08
+    server = BinderServer(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="dc0",
+        host="127.0.0.1", port=0, collector=collector, query_log=False,
+        flight_recorder=recorder, recursion=recursion,
+        degradation={"maxStalenessSeconds": max_staleness,
+                     "staleTtlClampSeconds": 5},
+        admission={"maxInflight": 128})
+    await server.start()
+    intro = Introspector(server=server, recorder=recorder,
+                         collector=collector, name="chaos-smoke")
+    intro.set_loop(asyncio.get_running_loop())
+
+    plan = FaultPlan(seed=7) \
+        .at(duration * 0.10, "upstream", loss=0.4) \
+        .at(duration * 0.20, "lose-session") \
+        .at(duration * 0.25, "watch-storm", n=100) \
+        .at(duration * 0.45, "loop-stall", ms=120) \
+        .at(duration * 0.65, "restore-session") \
+        .at(duration * 0.70, "upstream", clear=True)
+    plan.upstream = up_plan.upstream   # faults act on the live upstream
+
+    def mutate(i):
+        store.put_json(f"/test/chaos/churn{i % 4}",
+                       {"type": "host",
+                        "host": {"address": f"10.7.0.{i % 200 + 1}"}})
+
+    driver = ChaosDriver(plan, store=store, mutate=mutate,
+                         recorder=recorder)
+    chaos_task = driver.start()
+
+    pol = server._policy
+    stats = {"queries": 0, "ok": 0, "stale": 0, "refused": 0,
+             "servfail": 0, "rd_timeouts": 0}
+    snapshot_errs = []
+    t_end = asyncio.get_running_loop().time() + duration
+    i = 0
+    try:
+        while asyncio.get_running_loop().time() < t_end:
+            i += 1
+            rd = i % 5 == 0
+            name = (f"w.remote.{DOMAIN}" if rd
+                    else f"w{i % 8}.{DOMAIN}")
+            stats["queries"] += 1
+            try:
+                msg = await _ask(server.udp_port, name, Type.A,
+                                 qid=(i % 0xFFFF) + 1, rd=rd)
+            except asyncio.TimeoutError:
+                if not rd:
+                    raise Violation(f"local query for {name} hung")
+                stats["rd_timeouts"] += 1
+                continue
+            mode = pol.mode()
+            if msg.rcode == Rcode.NOERROR and msg.answers:
+                if mode == "stale-exhausted" and not rd:
+                    raise Violation("data served while stale-exhausted")
+                ds = store.disconnected_seconds()
+                if ds is not None and not rd \
+                        and ds > max_staleness + 1.0:
+                    raise Violation(
+                        f"answer served {ds:.2f}s stale "
+                        f"(cap {max_staleness:.2f}s)")
+                if mode == "stale-serving" and not rd:
+                    if any(a.ttl > 5 for a in msg.answers):
+                        raise Violation("stale answer TTL not clamped")
+                    stats["stale"] += 1
+                stats["ok"] += 1
+            elif msg.rcode == Rcode.REFUSED:
+                stats["refused"] += 1
+            elif msg.rcode == Rcode.SERVFAIL:
+                stats["servfail"] += 1
+            else:
+                raise Violation(f"unexpected rcode {msg.rcode}")
+            if i % 37 == 0:
+                errs = validate_status_snapshot(intro.snapshot())
+                if errs:
+                    snapshot_errs.extend(errs)
+            await asyncio.sleep(duration / 600.0)
+
+        await asyncio.wait_for(chaos_task, duration)
+        if snapshot_errs:
+            raise Violation(f"status snapshot: {snapshot_errs[:3]}")
+        if not stats["stale"]:
+            raise Violation("stale-serving window never observed")
+        if not stats["servfail"]:
+            raise Violation("stale-exhausted window never observed")
+
+        # -- re-convergence --
+        gen_before = cache.gen
+        store.put_json("/test/chaos/w0",
+                       {"type": "host", "host": {"address": "10.0.2.99"}})
+        if cache.gen <= gen_before:
+            raise Violation("mirror generation did not advance")
+        deadline = time.monotonic() + 5.0
+        while pol.mode() != "fresh" and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if pol.mode() != "fresh":
+            raise Violation("degraded state did not return to fresh")
+        if collector.get("binder_degraded_state").value() != 0.0:
+            raise Violation("binder_degraded_state != 0 after recovery")
+        msg = await _ask(server.udp_port, f"w0.{DOMAIN}", Type.A,
+                         qid=9999)
+        if msg.rcode != Rcode.NOERROR \
+                or msg.answers[0].address != "10.0.2.99":
+            raise Violation("post-recovery answer wrong")
+        if recursion.breakers.open_count():
+            raise Violation("breakers still open after recovery")
+        errs = validate_degradation_metrics(collector.expose())
+        if errs:
+            raise Violation(f"degradation metrics: {errs[:3]}")
+        stats["flight_events"] = dict(recorder.by_type)
+        stats["shed"] = dict(server._admission.shed_counts)
+        stats["stale_served_total"] = pol.stale_served
+        stats["withheld_total"] = pol.withheld
+        stats["duration_s"] = duration
+        return stats
+    finally:
+        await server.stop()
+        await recursion.close()
+        await upstream.stop()
+
+
+def run_smoke(duration: float = None) -> dict:
+    if duration is None:
+        duration = float(os.environ.get("BINDER_CHAOS_SECONDS", "30"))
+    return asyncio.run(_run(duration))
+
+
+def main() -> int:
+    try:
+        stats = run_smoke()
+    except Violation as e:
+        print(json.dumps({"chaos_smoke": "FAIL", "violation": str(e)}))
+        return 1
+    print(json.dumps({"chaos_smoke": "ok", **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -504,7 +504,8 @@ def validate_status_snapshot(snap):
         _check_keys(sub, schema, section, errs)
     # nullable top-level sections must still be PRESENT (consumers key
     # on them to know the feature is off, not mistyped)
-    for section in ("recursion", "precompile", "loop", "flight_recorder"):
+    for section in ("recursion", "precompile", "loop", "flight_recorder",
+                    "policy"):
         if section not in snap:
             errs.append(f"{section}: key must be present (null when "
                         "the subsystem is off)")
@@ -559,6 +560,30 @@ def validate_status_snapshot(snap):
                     "declined", "shed"):
             if key not in pc:
                 errs.append(f"precompile: missing {key!r}")
+    pol = snap.get("policy")
+    if isinstance(pol, dict):
+        for key in ("degradation", "admission", "breakers_open"):
+            if key not in pol:
+                errs.append(f"policy: missing {key!r}")
+        deg = pol.get("degradation")
+        if isinstance(deg, dict):
+            for key in ("state", "state_since_seconds",
+                        "max_staleness_seconds",
+                        "stale_ttl_clamp_seconds", "exhausted_action",
+                        "mirror_staleness_seconds", "stale_served",
+                        "withheld", "transitions"):
+                if key not in deg:
+                    errs.append(f"policy.degradation: missing {key!r}")
+            if deg.get("state") not in ("fresh", "stale-serving",
+                                        "stale-exhausted", None):
+                errs.append(f"policy.degradation.state: unknown state "
+                            f"{deg.get('state')!r}")
+        adm = pol.get("admission")
+        if isinstance(adm, dict):
+            for key in ("max_inflight", "inflight", "recursion_rate",
+                        "recursion_burst", "clients_tracked", "shed"):
+                if key not in adm:
+                    errs.append(f"policy.admission: missing {key!r}")
     return errs
 
 
@@ -603,6 +628,78 @@ def validate_precompile_metrics(text):
                         f"expected {kind!r}")
         if family not in sampled:
             errs.append(f"{family}: no samples in exposition")
+    return errs
+
+
+# ---- degradation / chaos metrics validator ----
+#
+# The degradation policy engine's whole point is that failure behavior
+# is *observable*: binder_degraded_state is what the alert rules watch,
+# binder_breaker_state is how an operator sees a dead peer being
+# routed around, binder_shed_total is the only record of refused load.
+# An exporter bug dropping any of them makes a degraded binder look
+# healthy — the exact silent failure this PR exists to kill.
+# validate_degradation_metrics() checks a scrape exposition for the
+# full family set with the right TYPEs, the label pins the dashboards
+# key on, and at least one sample each (every series is materialized
+# at registration, so absence is always a bug).  Wired into tier-1 via
+# tests/test_chaos.py and into `make chaos-smoke`.
+
+_DEGRADATION_FAMILIES = {
+    "binder_degraded_state": "gauge",
+    "binder_breaker_state": "gauge",
+    "binder_shed_total": "counter",
+    "binder_stale_served_total": "counter",
+    "binder_stale_withheld_total": "counter",
+}
+#: label values that must exist from scrape 1 (family -> label -> values)
+_DEGRADATION_LABEL_PINS = {
+    "binder_shed_total": ("reason", ("inflight-overflow",
+                                     "recursion-ratelimit")),
+    "binder_breaker_state": ("peer", ("(max)",)),
+}
+
+
+def validate_degradation_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    degradation/shedding family set (correct TYPE declarations, pinned
+    label values, at least one sample each).  Returns error strings;
+    empty == valid.  Scope: a FULLY configured binder — degradation +
+    admission blocks on AND recursion configured (the breaker family
+    registers with the recursion layer; a binder without upstreams has
+    nothing to break and legitimately lacks it)."""
+    errs = list(validate_exposition(text))
+    types = {}
+    labels_seen = {}    # family -> {label name -> set(values)}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            brace = line.find("{")
+            name = line[:brace] if brace >= 0 else parts[0]
+            fam_labels = labels_seen.setdefault(name, {})
+            if brace >= 0:
+                close = line.rfind("}")
+                for lname, lval in _parse_label_block(
+                        line[brace + 1:close], [], 0):
+                    fam_labels.setdefault(lname, set()).add(lval)
+            else:
+                fam_labels.setdefault(None, set()).add("")
+    for family, kind in _DEGRADATION_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in labels_seen:
+            errs.append(f"{family}: no samples in exposition")
+    for family, (label, values) in _DEGRADATION_LABEL_PINS.items():
+        have = labels_seen.get(family, {}).get(label, set())
+        for val in values:
+            if val not in have:
+                errs.append(f"{family}: missing pinned series "
+                            f"{label}={val!r}")
     return errs
 
 
