@@ -337,11 +337,20 @@ N_TC_FLOWS = int(os.environ.get("BENCH_TC_FLOWS", "1500"))
 
 
 async def _tc_retry_flows(port: int, n_flows: int,
-                          conc: int = 16) -> Dict[str, float]:
+                          conc: int = 6) -> Dict[str, float]:
     """The tc=1 flow a no-EDNS UDP client actually runs: UDP query ->
     truncated response -> RFC 1035 TCP retry -> full answer.  Driven
     from Python (the flow is latency-bound, not packet-rate-bound);
-    each flow's latency covers both legs including the TCP connect."""
+    each flow's latency covers both legs including the TCP connect.
+
+    The client is deliberately LEAN — raw sockets via loop.sock_*, and
+    header-level validation (TC bit, id, ancount) instead of a full
+    per-flow Message.decode — because the measured p50 is
+    conc x (client + server CPU) on the shared core: a heavy client
+    measures itself, not the serve path (the r05 figure's 10.8ms was
+    mostly asyncio-streams + decode cost queued 16 deep).  One sampled
+    flow per run still gets the full decode/compare, so wire
+    correctness stays asserted."""
     from binder_tpu.dns import Message as _M
 
     loop = asyncio.get_running_loop()
@@ -363,6 +372,7 @@ async def _tc_retry_flows(port: int, n_flows: int,
     sem = asyncio.Semaphore(conc)
     lats: List[float] = []
     errors = 0
+    sampled: List[bytes] = []
 
     async def one(i: int) -> None:
         nonlocal errors
@@ -380,30 +390,58 @@ async def _tc_retry_flows(port: int, n_flows: int,
             if not (resp[2] & 0x02):     # expected TC on the UDP leg
                 errors += 1
                 return
-            reader, writer = await asyncio.open_connection(
-                "127.0.0.1", port)
+            s = _socket_mod.socket(_socket_mod.AF_INET,
+                                   _socket_mod.SOCK_STREAM)
+            s.setblocking(False)
+
+            async def tcp_leg() -> Optional[bytes]:
+                await loop.sock_connect(s, ("127.0.0.1", port))
+                await loop.sock_sendall(
+                    s, len(q).to_bytes(2, "big") + q)
+                body = b""
+                need = None
+                while need is None or len(body) < need:
+                    chunk = await loop.sock_recv(s, 65536)
+                    if not chunk:
+                        return None
+                    body += chunk
+                    if need is None and len(body) >= 2:
+                        need = 2 + ((body[0] << 8) | body[1])
+                return body
+
             try:
-                writer.write(len(q).to_bytes(2, "big") + q)
-                await writer.drain()
-                hdr = await asyncio.wait_for(reader.readexactly(2), 5.0)
-                body = await asyncio.wait_for(
-                    reader.readexactly(int.from_bytes(hdr, "big")), 5.0)
+                # ONE watchdog around the whole leg: per-op wait_for
+                # wrappers cost ~15µs each in task/timer machinery,
+                # which the conc-deep queue multiplies into the p50
+                body = await asyncio.wait_for(tcp_leg(), 5.0)
+            except (OSError, asyncio.TimeoutError):
+                errors += 1
+                return
             finally:
-                writer.close()
-                try:
-                    await writer.wait_closed()
-                except (ConnectionResetError, BrokenPipeError, OSError):
-                    pass
-            m = _M.decode(body)
-            if m.tc or not m.answers:
+                s.close()
+            if body is None:
+                errors += 1
+                return
+            # header-level checks: id echo, QR, TC clear, answers
+            if (body[2:4] != q[:2] or not (body[4] & 0x80)
+                    or (body[4] & 0x02)
+                    or (body[8] << 8 | body[9]) == 0):
                 errors += 1
                 return
             lats.append(time.perf_counter() - t0)
+            if not sampled:
+                sampled.append(body[2:])
 
     t0 = time.perf_counter()
     await asyncio.gather(*[one(i) for i in range(n_flows)])
     elapsed = time.perf_counter() - t0
     transport.close()
+    if sampled:
+        # full decode on the sampled flow: the lean header checks must
+        # never hide a malformed wire
+        m = _M.decode(sampled[0])
+        if m.tc or not m.answers:
+            errors += 1
     lats.sort()
     return {
         "flows_per_s": n_flows / elapsed,
@@ -418,7 +456,16 @@ def _bench_tcp(tmpdir: str) -> Dict[str, float]:
     lib/server.js:643-653): persistent pipelined connections (tcp_qps),
     one-connection-per-query (tcp1_qps, the non-keep-alive client
     cost), and the tc=1 UDP->TCP retry flow for answers that truncate
-    at the classic 512-byte ceiling."""
+    at the classic 512-byte ceiling.
+
+    Interleaved A/B (the fix that tamed the balancer-overhead axis in
+    round 5): UDP passes (A, the in-window control) alternate with TCP
+    passes (B, the measured lane) against ONE server inside one time
+    window, so box drift lands in both sides and cancels out of the
+    `vs_udp` ratio.  The r05 scheme measured TCP passes back to back
+    and its 29k spread on a 199k mean was mostly the box, not the lane;
+    the spread is still reported honestly, but the ratio is the
+    stable figure."""
     fixture = os.path.join(tmpdir, "tcp_fixture.json")
     fix = dict(FIXTURE)
     # an answer set that must truncate for no-EDNS UDP clients
@@ -447,12 +494,43 @@ def _bench_tcp(tmpdir: str) -> Dict[str, float]:
             "bench server tcp listener")
         tmpl = os.path.join(tmpdir, "tcp_queries.bin")
         _write_templates(tmpl, BENCH_MIX)
-        res = _median_passes(
-            lambda: _drive_native(port, tmpdir, tmpl_path=tmpl,
-                                  mode="tcp"), N_PASSES)
-        t1 = _drive_native(port, tmpdir, tmpl_path=tmpl, n=N_TCP1,
-                           mode="tcp1")
+        _drive_native(port, tmpdir, tmpl_path=tmpl)              # warm A
+        _drive_native(port, tmpdir, tmpl_path=tmpl, mode="tcp")  # warm B
+        rounds = max(3, N_PASSES)
+        upasses: List[Dict[str, float]] = []
+        tpasses: List[Dict[str, float]] = []
+        for _ in range(rounds):
+            upasses.append(_drive_native(port, tmpdir, tmpl_path=tmpl))
+            tpasses.append(_drive_native(port, tmpdir, tmpl_path=tmpl,
+                                         mode="tcp"))
+
+        def med(passes):
+            passes = sorted(passes, key=lambda r: r["qps"])
+            r = dict(passes[len(passes) // 2])
+            r["qps_spread"] = round(
+                passes[-1]["qps"] - passes[0]["qps"], 1)
+            p99s = [p["p99_us"] for p in passes]
+            r["p99_spread_us"] = round(max(p99s) - min(p99s), 1)
+            r["passes"] = len(passes)
+            return r
+
+        res = med(tpasses)
+        umed = med(upasses)
+        # drift-cancelling figure: per-adjacent-pair ratio, median —
+        # both sides of each pair saw the same thermal/scheduler
+        # environment
+        ratios = sorted(t["qps"] / u["qps"]
+                        for t, u in zip(tpasses, upasses))
+        res["vs_udp"] = round(ratios[len(ratios) // 2], 3)
+        res["udp_ref_qps"] = round(umed["qps"], 1)
+        t1passes = [_drive_native(port, tmpdir, tmpl_path=tmpl,
+                                  n=N_TCP1, mode="tcp1")
+                    for _ in range(3)]
+        t1 = sorted(t1passes, key=lambda r: r["qps"])[1]
         res["tcp1_qps"] = round(t1["qps"], 1)
+        res["tcp1_qps_spread"] = round(
+            max(p["qps"] for p in t1passes)
+            - min(p["qps"] for p in t1passes), 1)
         res["tcp1_p99_us"] = round(t1["p99_us"], 1)
         tc = asyncio.run(_tc_retry_flows(port, N_TC_FLOWS))
         if tc["errors"] == 0:
@@ -1722,7 +1800,12 @@ def run_bench() -> Dict[str, object]:
         out["tcp_qps_spread"] = tcp.get("qps_spread")
         out["tcp_p50_us"] = round(tcp["p50_us"], 1)
         out["tcp_p99_us"] = round(tcp["p99_us"], 1)
+        # interleaved A/B: the drift-cancelled TCP-vs-UDP ratio and the
+        # in-window UDP control it was measured against
+        out["tcp_vs_udp"] = tcp.get("vs_udp")
+        out["tcp_udp_ref_qps"] = tcp.get("udp_ref_qps")
         out["tcp1_qps"] = tcp.get("tcp1_qps")
+        out["tcp1_qps_spread"] = tcp.get("tcp1_qps_spread")
         out["tcp1_p99_us"] = tcp.get("tcp1_p99_us")
         out["tc_retry_flows_per_s"] = tcp.get("tc_retry_flows_per_s")
         out["tc_retry_p50_us"] = tcp.get("tc_retry_p50_us")

@@ -15,6 +15,9 @@ DSL — one action per line (``;`` also separates), ``#`` comments::
     at 1.0  watch-storm n=600       # mutation burst through the store
     at 2.0  loop-stall ms=120       # synchronous event-loop stall
     at 2.5  upstream loss=0.3 delay_ms=40 dup=0.05
+    at 3.0  tcp-slow-reader conns=2 queries=512   # never reads answers
+    at 3.5  tcp-half-close queries=3    # send then SHUT_WR
+    at 3.8  tcp-rst conns=2             # torn frame + RST
     at 4.0  expire-session          # loss + immediate re-establish
     at 5.0  restore-session         # plain re-establish
     at 6.0  upstream clear          # all upstream faults off
@@ -35,6 +38,12 @@ Actions
   ``dup`` (duplicate-response probability), ``truncate`` (1 = answer
   TC=1 with no answers, forcing the TCP retry path), ``dead`` (1 =
   drop everything).  ``upstream clear`` resets all of them.
+- ``tcp-slow-reader`` / ``tcp-half-close`` / ``tcp-rst`` — misbehaving
+  stream-lane clients driven at the driver's ``tcp_target``
+  (``chaos/stream.py``): a pipelining client that never reads (must be
+  disconnected at the write-buffer cap), a send-then-SHUT_WR client
+  (must still get its answers), and a torn-frame RST (must never wedge
+  the connection table).
 
 Determinism: the plan carries its own seeded RNG; two runs with the
 same seed inject byte-identical fault decisions.
@@ -48,7 +57,9 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 ACTIONS = ("lose-session", "restore-session", "expire-session",
-           "watch-storm", "loop-stall", "upstream")
+           "watch-storm", "loop-stall", "upstream",
+           "tcp-slow-reader", "tcp-half-close", "tcp-rst")
+STREAM_ACTIONS = ("tcp-slow-reader", "tcp-half-close", "tcp-rst")
 
 
 class UpstreamFaults:
@@ -153,15 +164,21 @@ class ChaosDriver:
 
     def __init__(self, plan: FaultPlan, *, store=None,
                  mutate: Optional[Callable[[int], None]] = None,
+                 tcp_target: Optional[Tuple[str, int, str]] = None,
                  recorder=None,
                  log: Optional[logging.Logger] = None) -> None:
         self.plan = plan
         self.store = store
         self.mutate = mutate
+        # (host, port, qname) the stream faults connect to; None skips
+        # tcp-* actions with a warning (a plan driven only at the store
+        # needs no live listener)
+        self.tcp_target = tcp_target
         self.recorder = recorder
         self.log = log or logging.getLogger("binder.chaos")
         self.applied: List[Tuple[float, str]] = []
         self.started_mono: Optional[float] = None
+        self._stream_tasks: set = set()
 
     # -- action dispatch --
 
@@ -183,6 +200,8 @@ class ChaosDriver:
         elif action in ("lose-session", "restore-session",
                         "expire-session"):
             self._session_action(action)
+        elif action in STREAM_ACTIONS:
+            self._stream_action(action, kwargs)
         else:
             raise ValueError(f"unknown chaos action {action!r}")
         self.applied.append((time.monotonic(), action))
@@ -213,6 +232,32 @@ class ChaosDriver:
                              type(st).__name__, action)
             return
         fn()
+
+    def _stream_action(self, action: str, kwargs: dict) -> None:
+        if self.tcp_target is None:
+            self.log.warning("chaos: %s with no tcp target; skipped",
+                             action)
+            return
+        from binder_tpu.chaos.stream import run_stream_fault
+        coro = run_stream_fault(action, *self.tcp_target, **kwargs)
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (synchronous unit-test entry): drive inline
+            asyncio.run(coro)
+            return
+        # fault clients do real socket I/O: run them as tasks so the
+        # plan's timeline keeps its scripted instants
+        task = asyncio.ensure_future(coro)
+        self._stream_tasks.add(task)
+        task.add_done_callback(self._stream_tasks.discard)
+
+    async def stream_quiesce(self) -> None:
+        """Await completion of every in-flight stream fault client
+        (smokes assert table state after the faults, not during)."""
+        while self._stream_tasks:
+            await asyncio.gather(*list(self._stream_tasks),
+                                 return_exceptions=True)
 
     # -- the scripted run --
 

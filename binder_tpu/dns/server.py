@@ -27,6 +27,7 @@ import struct
 from typing import Callable, List, Optional, Tuple
 
 from binder_tpu.dns.query import QueryCtx
+from binder_tpu.dns.stream import TcpConn, TcpStats
 from binder_tpu.dns.wire import Message, OPTRecord, Rcode, WireError
 
 try:  # batched recvmmsg/sendmmsg datapath (built by `make -C native`)
@@ -129,8 +130,13 @@ class DnsServer:
                                      if max_tcp_write_buffer is None
                                      else max_tcp_write_buffer)
         # TCP clients only (balancer links are trusted local peers and
-        # excluded from the cap/idle policy)
+        # excluded from the cap/idle policy); members are TcpConn
+        # objects (dns/stream.py)
         self._tcp_conns: set = set()
+        # stream-lane counters (accepts, fast serves, promotions,
+        # coalesce economics, drop reasons) — folded into binder_tcp_*
+        # at scrape time by BinderServer
+        self.tcp_stats = TcpStats()
         # cap-refusal accounting: a connect flood at the cap must not
         # become a log flood, so refusals log at most once per interval
         # (with the count of everything refused since the last line)
@@ -140,7 +146,8 @@ class DnsServer:
         self.on_query: Optional[Callable] = None   # async (QueryCtx) -> None
         self.on_after: Optional[Callable] = None   # sync  (QueryCtx) -> None
         self._udp_socks: List[tuple] = []   # (loop, socket)
-        self._tcp_servers: List[asyncio.AbstractServer] = []
+        self._tcp_listeners: List[tuple] = []   # (loop, socket)
+        self._tcp_sweep_handle = None       # idle-sweep TimerHandle
         self._unix_servers: List[asyncio.AbstractServer] = []
         self._tasks: set = set()
         # live stream connections (TCP clients, balancer links) — must be
@@ -348,6 +355,15 @@ class DnsServer:
             return entry(self.fastpath, payload, gen)
         except (TypeError, ValueError):
             return None
+
+    def _serve_frames_bulk(self, buf: bytes, src):
+        """Bulk native TCP-frame serve (``fastpath_serve_frames``):
+        every complete frame in ``buf`` the C cache/zone can answer is
+        served and framed back as one block.  Returns
+        ``(resp_block, consumed, misses)`` or None when the native path
+        is unavailable/declined.  The one call site is the stream
+        lane's feed loop (dns/stream.py)."""
+        return self._fp_call(_fp_serve_frames, buf, src, "tcp")
 
     def _handle_raw(self, data: bytes, src: Tuple[str, int],
                     protocol: str, send: Callable[[bytes], None],
@@ -619,161 +635,135 @@ class DnsServer:
         return on_readable
 
     # -- TCP (2-byte length framing, RFC 1035 §4.2.2) --
+    #
+    # The stream lane runs on a raw accept loop + per-connection
+    # readiness callbacks (dns/stream.py TcpConn), not
+    # asyncio.start_server: protocol/StreamReader/StreamWriter/task
+    # creation per connection was the dominant cost of every fresh
+    # connection (tcp1 ~137µs, the tc=1 UDP→TCP retry flow 10.8ms p50
+    # in BENCH_r05).  With TCP_DEFER_ACCEPT the first frame normally
+    # rides the accept-readiness event, so a one-shot client is served
+    # inside the accept callback — one loop iteration end to end.
+
+    #: seconds a dataless connection may sit in the kernel's deferred-
+    #: accept queue before being surfaced anyway (Linux rounds up to
+    #: SYN-ACK retransmission boundaries).  Short enough that a patient
+    #: legitimate client only pays ~1s of first-byte latency; long
+    #: enough that connect-flood noise never occupies a connection slot.
+    TCP_DEFER_ACCEPT_S = 1
+    #: connections accepted per readiness callback — bounds event-loop
+    #: starvation under an accept flood, like _UDP_BURST for datagrams
+    _ACCEPT_BURST = 64
 
     async def listen_tcp(self, address: str, port: int,
                          announce: bool = True) -> int:
-        server = await asyncio.start_server(self._tcp_conn, address, port)
-        self._tcp_servers.append(server)
-        actual = server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+        fam = socket.AF_INET6 if ":" in address else socket.AF_INET
+        lsock = socket.socket(fam, socket.SOCK_STREAM)
+        try:
+            lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lsock.setblocking(False)
+            lsock.bind((address, port))
+            lsock.listen(1024)
+            # accept fast path: wake only when the first frame's bytes
+            # are already in the socket buffer (guarded: not every
+            # platform has the option, and serving must not depend on it)
+            try:
+                lsock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_DEFER_ACCEPT,
+                                 self.TCP_DEFER_ACCEPT_S)
+            except (AttributeError, OSError):
+                pass
+        except OSError:
+            # bind/listen failure (the pair-bind redraw path): leave no
+            # socket behind
+            lsock.close()
+            raise
+        loop.add_reader(lsock.fileno(), self._on_accept_ready, lsock,
+                        loop)
+        self._tcp_listeners.append((loop, lsock))
+        if self._tcp_sweep_handle is None and self.tcp_idle_timeout:
+            # ONE idle sweep for the whole connection table (vs a timer
+            # per connection): granularity T/4 keeps worst-case
+            # overstay at ~T/4 past the deadline
+            interval = max(0.05, min(self.tcp_idle_timeout / 4.0, 5.0))
+            self._tcp_sweep_handle = loop.call_later(
+                interval, self._sweep_idle_tcp, loop, interval)
+        actual = lsock.getsockname()[1]
         if announce:
             self.announce_tcp(address, actual)
         return actual
 
-    async def _tcp_conn(self, reader: asyncio.StreamReader,
-                        writer: asyncio.StreamWriter) -> None:
-        peer = writer.get_extra_info("peername") or ("?", 0)
-        if len(self._tcp_conns) >= self.max_tcp_conns:
-            # at the connection cap: refuse the newcomer outright (the
-            # idle timeout below guarantees slots recycle, so a
-            # slowloris herd can't pin the front end shut for long)
-            self.tcp_cap_refusals += 1
-            self._cap_log_pending += 1
-            now = asyncio.get_running_loop().time()
-            if now - self._cap_log_last >= 5.0:
-                self.log.warning(
-                    "TCP connection cap (%d) reached, refused %d "
-                    "connection(s) since last report (latest: %s; full "
-                    "count in binder_tcp_cap_refusals)",
-                    self.max_tcp_conns, self._cap_log_pending, peer[0])
-                self._cap_log_last = now
-                self._cap_log_pending = 0
-            writer.close()
+    def _on_accept_ready(self, lsock: socket.socket, loop) -> None:
+        stats = self.tcp_stats
+        for _ in range(self._ACCEPT_BURST):
             try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError, OSError):
-                pass
-            return
-        # TCP_NODELAY, explicitly: a DNS response is one small framed
-        # write, exactly the shape Nagle + delayed ACK turn into 40ms
-        # stalls (the loadgen sets it client-side already).  asyncio's
-        # selector transports set it by default, but that is an
-        # implementation detail of one event-loop family — the serving
-        # contract is pinned here, for every loop.
-        tsock = writer.get_extra_info("socket")
-        if tsock is not None:
-            try:
-                tsock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            except OSError:
-                pass
-        self._conns.add(writer)
-        self._tcp_conns.add(writer)
-
-        def send_block(framed: bytes) -> None:
-            # pre-framed bytes (one response, or the native bulk
-            # serve's whole block); bound is cap plus at most one
-            # 64KB frame of overshoot — the same bound the
-            # per-response path always had — so a non-reading client
-            # costs O(cap), not O(cap + arena), even for bulk blocks
-            transport = writer.transport
-            buffered = transport.get_write_buffer_size()
-            if (buffered > self.max_tcp_write_buffer
-                    or buffered + len(framed)
-                    > self.max_tcp_write_buffer + 65538):
-                self.log.warning(
-                    "TCP client %s not reading responses "
-                    "(>%d bytes buffered), aborting", peer[0],
-                    self.max_tcp_write_buffer)
-                transport.abort()
+                sock, peer = lsock.accept()
+            except (BlockingIOError, InterruptedError):
                 return
-            writer.write(framed)
+            except OSError as e:
+                self.log.error("TCP accept failed: %s", e)
+                return
+            stats.accepts += 1
+            if len(self._tcp_conns) >= self.max_tcp_conns:
+                # at the connection cap: refuse the newcomer outright
+                # (the idle sweep guarantees slots recycle, so a
+                # slowloris herd can't pin the front end shut for long)
+                self._refuse_at_cap(sock, peer, loop)
+                continue
+            sock.setblocking(False)
+            # (TCP_NODELAY is armed lazily by TcpConn — at promotion,
+            # or as soon as a second write becomes possible.  A
+            # one-shot client gets exactly one response write on a
+            # fresh connection, which Nagle sends immediately anyway,
+            # so the fast path skips the syscall.)
+            TcpConn(self, sock, peer, loop).start()
 
-        def send(wire: bytes) -> None:
-            send_block(struct.pack(">H", len(wire)) + wire)
-
-        src = (peer[0], peer[1])
-        buf = b""
-        loop = asyncio.get_running_loop()
-        idle = self.tcp_idle_timeout
-        deadline = loop.time() + idle if idle else None
+    def _refuse_at_cap(self, sock: socket.socket, peer, loop) -> None:
+        self.tcp_cap_refusals += 1
+        self._cap_log_pending += 1
+        now = loop.time()
+        if now - self._cap_log_last >= 5.0:
+            # a connect flood at the cap must not become a log flood:
+            # refusals log at most once per interval, with the count
+            self.log.warning(
+                "TCP connection cap (%d) reached, refused %d "
+                "connection(s) since last report (latest: %s; full "
+                "count in binder_tcp_cap_refusals)",
+                self.max_tcp_conns, self._cap_log_pending, peer[0])
+            self._cap_log_last = now
+            self._cap_log_pending = 0
         try:
-            while True:
-                # the idle deadline only advances when a COMPLETE frame
-                # is dispatched: a client trickling one byte per read
-                # ("slowloris") gets the same whole-frame deadline as a
-                # silent one
-                # asyncio.timeout_at is 3.11+; wait_for against the
-                # remaining budget gives the same whole-frame deadline
-                # on every supported interpreter
-                if deadline is None:
-                    chunk = await reader.read(65536)
-                else:
-                    chunk = await asyncio.wait_for(
-                        reader.read(65536),
-                        max(0.0, deadline - loop.time()))
-                if not chunk:
-                    break
-                # bulk reframe: every complete frame in the chunk is
-                # dispatched in one pass (pipelining clients land many
-                # queries per read; two awaits per query would dominate
-                # the TCP serve path)
-                buf = buf + chunk if buf else chunk
-                off = 0
-                # native bulk serve first: all complete frames the C
-                # cache/zone can answer are served and framed in ONE
-                # call + one writer.write; only misses (and frames past
-                # the C arena cap) fall through to the per-frame path
-                if len(buf) >= 2:
-                    bulk = self._fp_call(_fp_serve_frames, buf, src,
-                                         "tcp")
-                    if bulk is not None:
-                        resp, consumed, fmisses = bulk
-                        if resp:
-                            send_block(resp)
-                        for payload in fmisses:
-                            # already declined by the bulk serve: skip
-                            # the redundant per-payload fastpath probe
-                            self._handle_raw(payload, src, "tcp", send,
-                                             fastpath_checked=True)
-                        off = consumed
-                        if self.fastpath_log_flush is not None and resp:
-                            try:
-                                self.fastpath_log_flush()
-                            except Exception:
-                                self.log.exception(
-                                    "query-log ring drain failed")
-                n = len(buf)
-                while n - off >= 2:
-                    length = (buf[off] << 8) | buf[off + 1]
-                    if length == 0:
-                        # a zero-length frame is never valid DNS (min
-                        # header is 12 bytes) and would count as free
-                        # deadline progress for a slot-squatting client:
-                        # drop the connection outright
-                        self.log.debug(
-                            "closing TCP connection from %s: zero-length"
-                            " frame", peer[0])
-                        return
-                    if n - off - 2 < length:
-                        break
-                    self._handle_raw(buf[off + 2:off + 2 + length], src,
-                                     "tcp", send)
-                    off += 2 + length
-                buf = buf[off:] if off else buf
-                if off and idle:
-                    deadline = loop.time() + idle
-        except ConnectionResetError:
+            sock.close()
+        except OSError:
             pass
-        except (TimeoutError, asyncio.TimeoutError):
-            # asyncio.TimeoutError is a distinct class until 3.11
-            self.log.debug("closing idle TCP connection from %s", peer[0])
-        finally:
-            self._conns.discard(writer)
-            self._tcp_conns.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
+
+    def _sweep_idle_tcp(self, loop, interval: float) -> None:
+        self._tcp_sweep_handle = None
+        now = loop.time()
+        for conn in list(self._tcp_conns):
+            deadline = conn.deadline
+            if deadline is not None and now > deadline:
+                self.tcp_stats.idle_timeouts += 1
+                self.log.debug("closing idle TCP connection from %s",
+                               conn.peer[0])
+                conn.close()
+        if self._tcp_listeners or self._tcp_conns:
+            self._tcp_sweep_handle = loop.call_later(
+                interval, self._sweep_idle_tcp, loop, interval)
+
+    def tcp_introspect(self) -> dict:
+        """The ``/status`` ``tcp`` section: live connection-table state
+        plus the stream-lane counters (docs/observability.md)."""
+        out = self.tcp_stats.snapshot()
+        out.update({
+            "open_conns": len(self._tcp_conns),
+            "max_conns": self.max_tcp_conns,
+            "idle_timeout_seconds": float(self.tcp_idle_timeout or 0.0),
+            "max_write_buffer": self.max_tcp_write_buffer,
+            "cap_refusals": self.tcp_cap_refusals,
+        })
+        return out
 
     # -- balancer backend socket (docs/balancer-protocol.md) --
 
@@ -937,13 +927,22 @@ class DnsServer:
             except (OSError, ValueError):
                 pass
             sock.close()
+        if self._tcp_sweep_handle is not None:
+            self._tcp_sweep_handle.cancel()
+            self._tcp_sweep_handle = None
+        for loop, lsock in self._tcp_listeners:
+            try:
+                loop.remove_reader(lsock.fileno())
+            except (OSError, ValueError):
+                pass
+            lsock.close()
         for w in list(self._conns):
             w.close()
-        for s in self._tcp_servers + self._unix_servers:
+        for s in self._unix_servers:
             s.close()
             await s.wait_closed()
         for task in list(self._tasks):
             task.cancel()
         self._udp_socks.clear()
-        self._tcp_servers.clear()
+        self._tcp_listeners.clear()
         self._unix_servers.clear()

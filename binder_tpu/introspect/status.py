@@ -131,6 +131,7 @@ class Introspector:
             "store": self._store_section(),
             "mirror": self._mirror_section(),
             "answer_cache": self._cache_section(),
+            "tcp": self._tcp_section(),
             "inflight": self._inflight_section(),
             "recursion": self._recursion_section(),
             "precompile": self._precompile_section(),
@@ -203,6 +204,21 @@ class Introspector:
                     "compiled_entries": 0, "compiled_serves": 0,
                     "compiled_installs": 0}
         return self.server.answer_cache.stats()
+
+    def _tcp_section(self) -> dict:
+        """Stream-lane state (dns/stream.py): live connection table
+        plus accept/promotion/coalesce/drop counters — the "why is TCP
+        slow / shedding" section the runbook keys on
+        (docs/operations.md)."""
+        if self.server is not None:
+            return self.server.engine.tcp_introspect()
+        return {"open_conns": 0, "max_conns": 0,
+                "idle_timeout_seconds": 0.0, "max_write_buffer": 0,
+                "cap_refusals": 0, "accepts": 0, "fast_serves": 0,
+                "promotions": 0, "oneshot_closes": 0,
+                "idle_timeouts": 0, "slow_reader_drops": 0,
+                "coalesced_writes": 0, "coalesced_frames": 0,
+                "half_closes": 0, "rst_drops": 0}
 
     def _inflight_section(self) -> dict:
         queries = []

@@ -182,9 +182,16 @@ async def run(options: Dict[str, object]) -> BinderServer:
                 {"type": "host",
                  "host": {"address": f"10.254.{i % 8}.{i % 250 + 1}"}})
 
+        chaos_host = str(options.get("host", "0.0.0.0"))
+        if chaos_host in ("0.0.0.0", "::"):
+            chaos_host = "127.0.0.1"
         driver = ChaosDriver(
             plan, store=store,
             mutate=chaos_mutate if hasattr(store, "put_json") else None,
+            # stream faults (tcp-slow-reader / tcp-half-close /
+            # tcp-rst) drive the server's own TCP listener
+            tcp_target=(chaos_host, server.tcp_port,
+                        f"chaos0.{domain}"),
             recorder=recorder, log=log)
         server.chaos_driver = driver
         driver.start()

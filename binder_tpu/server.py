@@ -291,7 +291,44 @@ class BinderServer:
         self._cap_refusal_child = self.collector.counter(
             "binder_tcp_cap_refusals",
             "TCP connections refused at the connection cap").labelled()
+        self._cap_refusal_child.inc(0)   # series exists from scrape 1
         self._cap_folded = 0
+        # stream-lane counters (dns/stream.py TcpStats), folded at
+        # scrape time like the cap refusals; every series exists from
+        # scrape 1 so absence is always an exporter bug
+        # (tools/lint.py validate_tcp_metrics pins the family)
+        self._tcp_stat_children: dict = {}
+        for field, help_text in (
+            ("accepts", "TCP connections accepted"),
+            ("fast_serves", "frames served via the accept fast path "
+             "(connections not yet promoted to the pipelined protocol)"),
+            ("promotions", "TCP connections promoted to the full "
+             "pipelined protocol (kept sending after the first served "
+             "burst)"),
+            ("oneshot_closes", "TCP connections closed after serving "
+             "without ever promoting (one-shot clients)"),
+            ("idle_timeouts", "TCP connections dropped by the idle "
+             "deadline"),
+            ("slow_reader_drops", "TCP connections disconnected at the "
+             "write-buffer cap (client not reading responses)"),
+            ("coalesced_writes", "vectored TCP writes that carried "
+             "more than one response frame"),
+            ("coalesced_frames", "TCP response frames sent through "
+             "coalesced vectored writes"),
+            ("half_closes", "half-closed TCP connections held to "
+             "serve owed responses"),
+            ("rst_drops", "TCP connections dropped on reset/error "
+             "mid-read"),
+        ):
+            child = self.collector.counter("binder_tcp_" + field,
+                                           help_text).labelled()
+            child.inc(0)
+            self._tcp_stat_children[field] = child
+        self._tcp_stats_folded: dict = {}
+        self.collector.gauge(
+            "binder_tcp_open_conns",
+            "TCP client connections currently open"
+        ).set_function(lambda: float(len(self.engine._tcp_conns)))
         self.collector.on_expose(self._fold_engine_counters)
 
         # Raw resolve lane: direct wire assembly for single-question A/IN
@@ -1566,6 +1603,13 @@ class BinderServer:
             if delta > 0:
                 self._cap_refusal_child.inc(delta)
                 self._cap_folded += delta
+            snap = self.engine.tcp_stats.snapshot()
+            folded = self._tcp_stats_folded
+            for field, child in self._tcp_stat_children.items():
+                d = snap[field] - folded.get(field, 0)
+                if d > 0:
+                    child.inc(d)
+                    folded[field] = snap[field]
 
     def _fold_fastpath_metrics(self) -> None:
         """Fold the C fast path's monotonic counters into the Prometheus

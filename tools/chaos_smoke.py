@@ -3,9 +3,10 @@
 
 Boots a full in-process binder (fake store + recursion to a chaos
 upstream + degradation/admission policy), runs a scripted FaultPlan —
-upstream packet loss, ZK session loss mid-churn, a watch storm, an
-event-loop stall, then recovery — while driving continuous queries,
-and asserts the PR's acceptance invariants:
+upstream packet loss, ZK session loss mid-churn, a watch storm,
+misbehaving stream clients (slow reader / half-close / torn-frame
+RST), an event-loop stall, then recovery — while driving continuous
+queries, and asserts the PR's acceptance invariants:
 
 - every query gets a well-formed answer or refusal (never a hang);
 - data answers are served only while fresh or within
@@ -108,6 +109,10 @@ async def _run(duration: float) -> dict:
         .at(duration * 0.10, "upstream", loss=0.4) \
         .at(duration * 0.20, "lose-session") \
         .at(duration * 0.25, "watch-storm", n=100) \
+        .at(duration * 0.30, "tcp-slow-reader", conns=1, queries=64,
+            hold_ms=200) \
+        .at(duration * 0.35, "tcp-half-close", queries=2) \
+        .at(duration * 0.40, "tcp-rst", conns=2) \
         .at(duration * 0.45, "loop-stall", ms=120) \
         .at(duration * 0.65, "restore-session") \
         .at(duration * 0.70, "upstream", clear=True)
@@ -119,6 +124,8 @@ async def _run(duration: float) -> dict:
                         "host": {"address": f"10.7.0.{i % 200 + 1}"}})
 
     driver = ChaosDriver(plan, store=store, mutate=mutate,
+                         tcp_target=("127.0.0.1", server.tcp_port,
+                                     f"w0.{DOMAIN}"),
                          recorder=recorder)
     chaos_task = driver.start()
 
@@ -198,9 +205,22 @@ async def _run(duration: float) -> dict:
             raise Violation("post-recovery answer wrong")
         if recursion.breakers.open_count():
             raise Violation("breakers still open after recovery")
+        # stream-lane re-convergence: the misbehaving TCP clients
+        # (slow reader, half-close, torn-frame RST) were all shed and
+        # the connection table is empty again
+        await driver.stream_quiesce()
+        deadline = time.monotonic() + 5.0
+        while server.engine._tcp_conns and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if server.engine._tcp_conns:
+            raise Violation("TCP connection table did not re-converge")
+        tcp_stats = server.engine.tcp_stats
+        if not tcp_stats.accepts:
+            raise Violation("stream faults never reached the listener")
         errs = validate_degradation_metrics(collector.expose())
         if errs:
             raise Violation(f"degradation metrics: {errs[:3]}")
+        stats["tcp"] = tcp_stats.snapshot()
         stats["flight_events"] = dict(recorder.by_type)
         stats["shed"] = dict(server._admission.shed_counts)
         stats["stale_served_total"] = pol.stale_served

@@ -466,6 +466,18 @@ _SNAPSHOT_SCHEMA = {
     "inflight": {
         "count": (int, False), "queries": (list, False),
     },
+    "tcp": {
+        "open_conns": (int, False), "max_conns": (int, False),
+        "idle_timeout_seconds": (_NUM, False),
+        "max_write_buffer": (int, False),
+        "cap_refusals": (int, False), "accepts": (int, False),
+        "fast_serves": (int, False), "promotions": (int, False),
+        "oneshot_closes": (int, False), "idle_timeouts": (int, False),
+        "slow_reader_drops": (int, False),
+        "coalesced_writes": (int, False),
+        "coalesced_frames": (int, False), "half_closes": (int, False),
+        "rst_drops": (int, False),
+    },
 }
 _SESSION_STATES = ("never-connected", "connected", "degraded", "expired",
                    "closed")
@@ -700,6 +712,58 @@ def validate_degradation_metrics(text):
             if val not in have:
                 errs.append(f"{family}: missing pinned series "
                             f"{label}={val!r}")
+    return errs
+
+
+# ---- TCP stream-lane metrics validator ----
+#
+# The stream lane's performance story is only auditable through its
+# counters: fast_serves vs promotions names whether the accept fast
+# path is actually carrying the one-shot population, and the drop
+# counters (idle / slow-reader / cap) are the only record of shed
+# connections.  validate_tcp_metrics() checks a scrape exposition for
+# the full binder_tcp_* family with the right TYPEs and at least one
+# sample each (every series is materialized at registration, so absence
+# is always an exporter bug).  Wired into tier-1 via
+# tests/test_tcp_stream.py and into `make tcp-smoke`.
+
+_TCP_FAMILIES = {
+    "binder_tcp_accepts": "counter",
+    "binder_tcp_fast_serves": "counter",
+    "binder_tcp_promotions": "counter",
+    "binder_tcp_oneshot_closes": "counter",
+    "binder_tcp_idle_timeouts": "counter",
+    "binder_tcp_slow_reader_drops": "counter",
+    "binder_tcp_coalesced_writes": "counter",
+    "binder_tcp_coalesced_frames": "counter",
+    "binder_tcp_half_closes": "counter",
+    "binder_tcp_rst_drops": "counter",
+    "binder_tcp_cap_refusals": "counter",
+    "binder_tcp_open_conns": "gauge",
+}
+
+
+def validate_tcp_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_tcp_*`` family (correct TYPE declarations and at least one
+    sample each).  Returns error strings; empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    sampled = set()
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            sampled.add(parts[0].split("{", 1)[0])
+    for family, kind in _TCP_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in sampled:
+            errs.append(f"{family}: no samples in exposition")
     return errs
 
 

@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""tcp-smoke: the stream lane end to end, in one scripted pass.
+
+Boots a fake-store binder and exercises every stream-lane serving
+shape the ISSUE-5 overhaul touches:
+
+- a **one-shot** client (connect → query → read → close): the accept
+  fast path must serve it and account the close
+  (``binder_tcp_fast_serves`` / ``binder_tcp_oneshot_closes``);
+- a **pipelined** client (two bursts on one connection): the second
+  burst must promote (``binder_tcp_promotions``) and a multi-frame
+  burst must coalesce into vectored writes;
+- a **slow reader** against a small write-buffer cap: must be
+  disconnected at the cap (``binder_tcp_slow_reader_drops``), and the
+  server must keep serving others;
+- a **half-close** client (send then SHUT_WR): must still receive its
+  answer;
+- a **torn-frame RST**: the connection table must re-converge to
+  empty.
+
+Then validates the ``binder_tcp_*`` exposition
+(``tools/lint.py validate_tcp_metrics``) and the ``/status`` ``tcp``
+section schema.  Prints one JSON summary line; exit 0 == all held.
+Run via ``make tcp-smoke``.
+"""
+import asyncio
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.chaos.stream import (half_close,  # noqa: E402
+                                     rst_mid_frame)
+from binder_tpu.dns import Message, Rcode, Type, make_query  # noqa: E402
+from binder_tpu.introspect import Introspector  # noqa: E402
+from binder_tpu.metrics.collector import MetricsCollector  # noqa: E402
+from binder_tpu.server import BinderServer  # noqa: E402
+from binder_tpu.store import FakeStore, MirrorCache  # noqa: E402
+from tools.lint import (validate_status_snapshot,  # noqa: E402
+                        validate_tcp_metrics)
+
+DOMAIN = "smoke.test"
+
+
+class Violation(Exception):
+    pass
+
+
+async def _oneshot(port, name, qid=1):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    wire = make_query(name, Type.A, qid=qid).encode()
+    writer.write(struct.pack(">H", len(wire)) + wire)
+    await writer.drain()
+    (ln,) = struct.unpack(">H", await asyncio.wait_for(
+        reader.readexactly(2), 5))
+    data = await asyncio.wait_for(reader.readexactly(ln), 5)
+    writer.close()
+    await writer.wait_closed()
+    return Message.decode(data)
+
+
+async def _pipelined_bursts(port, name, per_burst=8, bursts=2):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    got = 0
+    for b in range(bursts):
+        block = b""
+        for i in range(per_burst):
+            wire = make_query(name, Type.A,
+                              qid=b * per_burst + i + 1).encode()
+            block += struct.pack(">H", len(wire)) + wire
+        writer.write(block)
+        await writer.drain()
+        for _ in range(per_burst):
+            (ln,) = struct.unpack(">H", await asyncio.wait_for(
+                reader.readexactly(2), 5))
+            msg = Message.decode(await asyncio.wait_for(
+                reader.readexactly(ln), 5))
+            if msg.rcode != Rcode.NOERROR:
+                raise Violation(f"pipelined rcode {msg.rcode}")
+            got += 1
+    writer.close()
+    await writer.wait_closed()
+    return got
+
+
+async def _slow_reader_leg(port):
+    """Pump large answers without reading until the server aborts us."""
+    loop = asyncio.get_running_loop()
+    raw = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    raw.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    raw.setblocking(False)
+    await loop.sock_connect(raw, ("127.0.0.1", port))
+    wire = make_query(f"svc.{DOMAIN}", Type.A, qid=1,
+                      edns_payload=4096).encode()
+    frame = struct.pack(">H", len(wire)) + wire
+    try:
+        for i in range(20000):
+            await loop.sock_sendall(raw, frame)
+            if i % 64 == 0:
+                await asyncio.sleep(0)
+    except (ConnectionResetError, BrokenPipeError, OSError):
+        return True
+    finally:
+        raw.close()
+    return False
+
+
+async def _run() -> dict:
+    collector = MetricsCollector()
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/test/smoke/web",
+                   {"type": "host", "host": {"address": "10.5.0.1"}})
+    store.put_json("/test/smoke/svc", {
+        "type": "service",
+        "service": {"srvce": "_s", "proto": "_tcp", "port": 80}})
+    for i in range(40):
+        store.put_json(f"/test/smoke/svc/m{i}",
+                       {"type": "load_balancer",
+                        "load_balancer": {"address": f"10.5.1.{i + 1}"}})
+    store.start_session()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="dc0", host="127.0.0.1",
+                          port=0, collector=collector, query_log=False,
+                          max_tcp_write_buffer=4096)
+    await server.start()
+    engine = server.engine
+    stats = engine.tcp_stats
+    try:
+        # 1. one-shot (accept fast path)
+        r = await _oneshot(server.tcp_port, f"web.{DOMAIN}")
+        if r.rcode != Rcode.NOERROR:
+            raise Violation(f"one-shot rcode {r.rcode}")
+        deadline = time.monotonic() + 5.0
+        while not stats.oneshot_closes and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        if not (stats.fast_serves and stats.oneshot_closes):
+            raise Violation("accept fast path did not serve/close "
+                            f"({stats.snapshot()})")
+
+        # 2. pipelined bursts (promotion + coalescing)
+        n = await _pipelined_bursts(server.tcp_port, f"web.{DOMAIN}")
+        if n != 16:
+            raise Violation(f"pipelined burst served {n}/16")
+        if not stats.promotions:
+            raise Violation("second burst did not promote")
+        if not stats.coalesced_writes:
+            raise Violation("burst responses were not coalesced")
+
+        # 3. slow reader: disconnected at the cap
+        if not await _slow_reader_leg(server.tcp_port):
+            raise Violation("slow reader never disconnected")
+        if not stats.slow_reader_drops:
+            raise Violation("slow-reader drop not counted")
+        r = await _oneshot(server.tcp_port, f"web.{DOMAIN}", qid=2)
+        if r.rcode != Rcode.NOERROR:
+            raise Violation("server unhealthy after slow-reader abort")
+
+        # 4. half-close + 5. torn-frame RST (the chaos fault clients)
+        await half_close("127.0.0.1", server.tcp_port, f"web.{DOMAIN}")
+        await rst_mid_frame("127.0.0.1", server.tcp_port)
+        deadline = time.monotonic() + 5.0
+        while engine._tcp_conns and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        if engine._tcp_conns:
+            raise Violation("connection table did not re-converge")
+
+        # 6. observability gates
+        errs = validate_tcp_metrics(collector.expose())
+        if errs:
+            raise Violation(f"tcp metrics: {errs[:3]}")
+        intro = Introspector(server=server, collector=collector,
+                             name="tcp-smoke")
+        errs = validate_status_snapshot(intro.snapshot())
+        if errs:
+            raise Violation(f"status snapshot: {errs[:3]}")
+        return {"tcp": stats.snapshot(),
+                "cap_refusals": engine.tcp_cap_refusals}
+    finally:
+        await server.stop()
+
+
+def main() -> int:
+    try:
+        stats = asyncio.run(_run())
+    except Violation as e:
+        print(json.dumps({"tcp_smoke": "FAIL", "violation": str(e)}))
+        return 1
+    print(json.dumps({"tcp_smoke": "ok", **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
