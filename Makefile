@@ -4,7 +4,7 @@
 PY ?= python3
 
 .PHONY: all native test check ci bench bench-smoke status-smoke \
-	chaos-smoke tcp-smoke real-tiers clean
+	chaos-smoke tcp-smoke shard-smoke real-tiers clean
 
 all: native
 
@@ -53,6 +53,7 @@ ci:
 	$(MAKE) bench-smoke
 	BINDER_CHAOS_SECONDS=10 $(MAKE) chaos-smoke
 	$(MAKE) tcp-smoke
+	BINDER_SHARD_SECONDS=10 $(MAKE) shard-smoke
 	@echo "ci: all gates passed"
 
 # one fast reduced-iteration bench pass proving the measured paths still
@@ -62,7 +63,7 @@ bench-smoke: native
 	@mkdir -p .scratch
 	BENCH_QUERIES=5000 BENCH_PASSES=1 BENCH_MISS_QUERIES=2000 \
 		BENCH_RECURSION_QUERIES=2000 BENCH_TCP1_QUERIES=1500 \
-		BENCH_TC_FLOWS=300 \
+		BENCH_TC_FLOWS=300 BENCH_SHARD_NS=1,2 \
 		BENCH_BASELINE_FILE=.scratch/bench_smoke_baseline.json \
 		$(PY) bench.py
 
@@ -83,6 +84,15 @@ status-smoke:
 # (tier-1 runs the same harness short via tests/test_chaos.py)
 chaos-smoke:
 	$(PY) tools/chaos_smoke.py
+
+# shard-mode end-to-end smoke: 30 s N=2 supervisor (real worker
+# processes on one SO_REUSEPORT port), scripted shard-kill mid-load,
+# respawn + snapshot catch-up, cross-shard answer parity, SIGTERM
+# drain with no orphan PIDs, binder_shard_* exposition validation
+# (docs/operations.md "Sharded serving"); BINDER_SHARD_SECONDS
+# overrides the duration
+shard-smoke:
+	$(PY) tools/shard_smoke.py
 
 # stream-lane end-to-end smoke: one-shot (accept fast path), pipelined
 # promotion + write coalescing, slow-reader disconnect at the

@@ -25,6 +25,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from binder_tpu.dns import Type, make_query
@@ -81,9 +82,17 @@ def _env_fingerprint() -> Dict[str, object]:
         load1 = round(os.getloadavg()[0], 2)
     except OSError:
         load1 = None
-    return {"cpu": model, "cores": NPROC, "pinned": PINNED,
-            "server_cores": SERVER_CORES if PINNED else None,
-            "client_cores": CLIENT_CORES if PINNED else None,
+    # ALWAYS record the allowed-CPU set and machine core count, pinned
+    # or not: an unpinned run previously wrote nulls here, making
+    # scaling/efficiency numbers unreadable against the actual CPU
+    # topology (which is exactly what the shard axis divides by)
+    all_cores = ",".join(str(c) for c in _CORES)
+    return {"cpu": model, "cores": NPROC,
+            "affinity": all_cores,
+            "nproc_machine": os.cpu_count(),
+            "pinned": PINNED,
+            "server_cores": SERVER_CORES if PINNED else all_cores,
+            "client_cores": CLIENT_CORES if PINNED else all_cores,
             "loadavg_start": load1, "passes": N_PASSES}
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", "50000"))
 # hot-axis passes: p99 on a single shared-core box varies ±40% run to
@@ -1669,6 +1678,153 @@ def _bench_degraded(tmpdir: str) -> Dict[str, object]:
     }
 
 
+#: shard worker counts the shard axis measures (comma-separated env
+#: override; `make bench-smoke` trims it to keep CI fast)
+SHARD_NS = [int(x) for x in os.environ.get(
+    "BENCH_SHARD_NS", "1,2,4").split(",") if x.strip()]
+#: concurrent load-generator processes for the shard axis: SO_REUSEPORT
+#: balances by 4-tuple hash, so ONE client socket would land every
+#: query on one worker — distinct source sockets are what make the
+#: kernel spread.  Balance is flow-granular (each client is ONE flow),
+#: so enough flows are needed for the distribution figure to mean
+#: anything: with 16 flows over 4 shards, an empty shard is ~4%
+#: probable by chance; with 4 flows it was ~12% probable over TWO.
+SHARD_CLIENTS = int(os.environ.get("BENCH_SHARD_CLIENTS", "16"))
+
+
+def _drive_native_shard(port: int, tmpl_path: str,
+                        n_total: int) -> Dict[str, float]:
+    """SHARD_CLIENTS concurrent dnsblast processes against one port.
+
+    Aggregate qps is total-queries / wall-clock of the whole batch (the
+    slowest client closes the window — summing per-process qps would
+    overcount when finish times skew).  p50 is the median of the
+    per-process medians; p99 the worst process's p99 (conservative)."""
+    per = max(1, n_total // SHARD_CLIENTS)
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(
+        _pin("client")
+        + [DNSBLAST, "-p", str(port), "-n", str(per),
+           "-w", str(max(8, CONCURRENCY // SHARD_CLIENTS)),
+           "-t", tmpl_path],
+        stdout=subprocess.PIPE, text=True)
+        for _ in range(SHARD_CLIENTS)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=330)
+            if p.returncode:
+                raise RuntimeError(
+                    f"dnsblast exited {p.returncode} on shard axis")
+            outs.append(json.loads(out))
+    finally:
+        for p in procs:
+            _reap(p)
+    elapsed = time.perf_counter() - t0
+    p50s = sorted(o["p50_us"] for o in outs)
+    return {
+        "qps": per * SHARD_CLIENTS / elapsed,
+        "p50_us": p50s[len(p50s) // 2],
+        "p99_us": max(o["p99_us"] for o in outs),
+        "errors": sum(o.get("errors", 0) for o in outs),
+        "client_procs": SHARD_CLIENTS,
+    }
+
+
+def _shard_status(mport: int) -> Dict[str, object]:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/status", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _bench_shard(tmpdir: str) -> Dict[str, object]:
+    """Shard axis (ISSUE 6): `shard_qps` at N=1/2/4 worker processes
+    behind one kernel-balanced SO_REUSEPORT port, with:
+
+    - an in-process control (`inproc_qps`) measured with the SAME
+      multi-process client topology, so `shard-mode overhead at N=1`
+      is a like-for-like ratio (the headline axis uses one client and
+      is not comparable);
+    - scaling efficiency vs ideal = min(N, cores) — on the 1-core dev
+      VM ideal is 1 and the honest pass is mechanism + overhead; on
+      multi-core hardware the same figure is the scaling headline;
+    - per-shard query-distribution balance (min/max share of the
+      `binder_shard_requests` fold) proving the kernel actually
+      spread the load;
+    - shard PIDs recorded so the "N distinct processes" claim is
+      checkable in the JSON."""
+    fixture = os.path.join(tmpdir, "shard_fixture.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    tmpl = os.path.join(tmpdir, "shard_queries.bin")
+    _write_templates(tmpl, BENCH_MIX)
+
+    def boot(shards: int):
+        config = os.path.join(tmpdir, f"shard_config_{shards}.json")
+        with open(config, "w") as f:
+            json.dump({
+                "dnsDomain": "bench.com", "datacenterName": "dc0",
+                "host": "127.0.0.1",
+                "store": {"backend": "fake", "fixture": fixture},
+                "queryLog": False,
+                **({"shards": shards} if shards else {}),
+            }, f)
+        return _launch_server(config)
+
+    out: Dict[str, object] = {"ns": SHARD_NS, "clients": SHARD_CLIENTS,
+                              "cores": NPROC, "qps": {}, "p50_us": {},
+                              "p99_us": {}, "qps_spread": {},
+                              "pids": {}, "balance": {}}
+    # in-process control: same stack, no supervisor, same client shape
+    proc = boot(0)
+    try:
+        port, _ = wait_for_ports(proc)
+        ctl = _median_passes(
+            lambda: _drive_native_shard(port, tmpl, N_QUERIES),
+            N_PASSES)
+        out["inproc_qps"] = round(ctl["qps"], 1)
+        out["inproc_qps_spread"] = ctl.get("qps_spread")
+    finally:
+        _reap(proc)
+
+    for n in SHARD_NS:
+        proc = boot(n)
+        try:
+            port, mport = wait_for_ports(proc)
+            res = _median_passes(
+                lambda: _drive_native_shard(port, tmpl, N_QUERIES),
+                N_PASSES)
+            key = str(n)
+            out["qps"][key] = round(res["qps"], 1)
+            out["qps_spread"][key] = res.get("qps_spread")
+            out["p50_us"][key] = round(res["p50_us"], 1)
+            out["p99_us"][key] = round(res["p99_us"], 1)
+            # let the final 1 Hz stats frames fold before reading the
+            # per-shard distribution
+            time.sleep(2.0)
+            snap = _shard_status(mport)
+            workers = snap["shards"]["workers"]
+            out["pids"][key] = [w["pid"] for w in workers]
+            reqs = [float(w["requests"]) for w in workers]
+            if n > 1 and sum(reqs) > 0:
+                shares = [r / sum(reqs) for r in reqs]
+                # 1.0 = perfectly even; 0 = one shard took everything
+                out["balance"][key] = round(
+                    min(shares) / max(shares), 3)
+        finally:
+            _reap(proc)
+
+    base = out["qps"].get("1")
+    if base:
+        out["efficiency"] = {
+            str(n): round(out["qps"][str(n)]
+                          / (base * min(n, NPROC)), 3)
+            for n in SHARD_NS if str(n) in out["qps"]}
+        out["shard1_overhead_pct"] = round(
+            (1.0 - base / out["inproc_qps"]) * 100.0, 1)
+    return out
+
+
 def _try_axis(name: str, fn, retries: int = 1):
     """Run one bench axis, retrying once on failure: every axis is
     exception-guarded so a transient (a busy box stretching a startup
@@ -1687,7 +1843,7 @@ def _try_axis(name: str, fn, retries: int = 1):
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
-    realistic = degraded = None
+    realistic = degraded = shard = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -1711,6 +1867,7 @@ def run_bench() -> Dict[str, object]:
                                   lambda: _bench_realistic(tmpdir))
             degraded = _try_axis("degraded",
                                  lambda: _bench_degraded(tmpdir))
+            shard = _try_axis("shard", lambda: _bench_shard(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -1902,6 +2059,27 @@ def run_bench() -> Dict[str, object]:
         out["degraded_withheld_qps"] = round(degraded["withheld_qps"], 1)
         out["degraded_withheld_p99_us"] = round(
             degraded["withheld_p99_us"], 1)
+    if shard is not None:
+        # shard axis (ISSUE 6): N worker processes behind one kernel-
+        # balanced SO_REUSEPORT port, one mirror owner.  qps/efficiency
+        # keyed by N; `inproc` is the no-supervisor control measured
+        # with the SAME multi-process client topology, so
+        # shard1_overhead_pct is the honest cost of the mechanism at
+        # N=1 (ideal = min(N, cores); on a 1-core box N>1 efficiency
+        # is expected < 1 and the mechanism numbers are the point)
+        out["shard_qps"] = shard["qps"]
+        out["shard_qps_spread"] = shard["qps_spread"]
+        out["shard_p50_us"] = shard["p50_us"]
+        out["shard_p99_us"] = shard["p99_us"]
+        out["shard_efficiency"] = shard.get("efficiency")
+        out["shard_balance"] = shard["balance"]
+        out["shard_inproc_ref_qps"] = shard.get("inproc_qps")
+        out["shard1_overhead_pct"] = shard.get("shard1_overhead_pct")
+        out["shard_clients"] = shard["clients"]
+        # the env block carries the shard PIDs/cores so the "N
+        # distinct processes on M cores" claim is checkable in the JSON
+        env["shard_pids"] = shard["pids"]
+        env["shard_cores"] = shard["cores"]
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm,
         # with the balancer's own per-stage attribution riding along

@@ -19,6 +19,7 @@ DSL — one action per line (``;`` also separates), ``#`` comments::
     at 3.5  tcp-half-close queries=3    # send then SHUT_WR
     at 3.8  tcp-rst conns=2             # torn frame + RST
     at 4.0  expire-session          # loss + immediate re-establish
+    at 4.5  shard-kill shard=0      # SIGKILL a serving shard worker
     at 5.0  restore-session         # plain re-establish
     at 6.0  upstream clear          # all upstream faults off
 
@@ -44,6 +45,12 @@ Actions
   disconnected at the write-buffer cap), a send-then-SHUT_WR client
   (must still get its answers), and a torn-frame RST (must never wedge
   the connection table).
+- ``shard-kill [shard=I]`` — SIGKILL one shard worker mid-load via the
+  driver's ``shard_target`` (the supervisor's ``kill_shard``;
+  ``shard`` omitted or -1 picks a live worker at random).  The
+  acceptance invariant is the supervisor's: the kernel re-hashes the
+  dead socket's share to the survivors at once, and the respawned
+  worker catches up from snapshot (binder_tpu/shard).
 
 Determinism: the plan carries its own seeded RNG; two runs with the
 same seed inject byte-identical fault decisions.
@@ -58,7 +65,8 @@ from typing import Callable, List, Optional, Tuple
 
 ACTIONS = ("lose-session", "restore-session", "expire-session",
            "watch-storm", "loop-stall", "upstream",
-           "tcp-slow-reader", "tcp-half-close", "tcp-rst")
+           "tcp-slow-reader", "tcp-half-close", "tcp-rst",
+           "shard-kill")
 STREAM_ACTIONS = ("tcp-slow-reader", "tcp-half-close", "tcp-rst")
 
 
@@ -165,6 +173,7 @@ class ChaosDriver:
     def __init__(self, plan: FaultPlan, *, store=None,
                  mutate: Optional[Callable[[int], None]] = None,
                  tcp_target: Optional[Tuple[str, int, str]] = None,
+                 shard_target: Optional[Callable[[int], object]] = None,
                  recorder=None,
                  log: Optional[logging.Logger] = None) -> None:
         self.plan = plan
@@ -174,6 +183,9 @@ class ChaosDriver:
         # tcp-* actions with a warning (a plan driven only at the store
         # needs no live listener)
         self.tcp_target = tcp_target
+        # shard-kill sink: the supervisor's kill_shard(index) (index -1
+        # = random live worker); None skips with a warning
+        self.shard_target = shard_target
         self.recorder = recorder
         self.log = log or logging.getLogger("binder.chaos")
         self.applied: List[Tuple[float, str]] = []
@@ -200,6 +212,12 @@ class ChaosDriver:
         elif action in ("lose-session", "restore-session",
                         "expire-session"):
             self._session_action(action)
+        elif action == "shard-kill":
+            if self.shard_target is None:
+                self.log.warning("chaos: shard-kill with no shard "
+                                 "target; skipped")
+            else:
+                self.shard_target(int(kwargs.get("shard", -1)))
         elif action in STREAM_ACTIONS:
             self._stream_action(action, kwargs)
         else:

@@ -11,6 +11,17 @@ the same flags:
     -v         increase verbosity (stackable, -vv -> trace)
     -h         usage
 
+plus the shard-mode long options (docs/operations.md "Sharded
+serving"):
+
+    --shards <n>        fork n serving workers behind one
+                        SO_REUSEPORT port, supervised by this process
+                        (config key ``shards``; 0/absent = classic
+                        single-process serving)
+    --shard-worker <i>  INTERNAL: run as shard worker i, reading the
+                        mutation log from the inherited
+                        BINDER_SHARD_FD socketpair
+
 The config file is the SAPI-rendered equivalent (reference
 ``sapi_manifests/binder/template``): ``dnsDomain``, ``datacenterName``,
 optional ``recursion`` block, optional ``store`` block selecting the
@@ -32,7 +43,7 @@ DEFAULTS: Dict[str, object] = {
 }
 
 USAGE = ("usage: binder [-v] [-a cacheExpiry] [-s cacheSize] [-p port] "
-         "[-b balancerSocket] [-f file]")
+         "[-b balancerSocket] [-f file] [--shards n]")
 
 
 class ConfigError(Exception):
@@ -42,7 +53,8 @@ class ConfigError(Exception):
 def parse_options(argv: Optional[List[str]] = None) -> Dict[str, object]:
     argv = sys.argv[1:] if argv is None else argv
     try:
-        optlist, _ = getopt.getopt(argv, "hva:b:s:p:f:")
+        optlist, _ = getopt.getopt(argv, "hva:b:s:p:f:",
+                                   ["shards=", "shard-worker="])
     except getopt.GetoptError as e:
         raise ConfigError(f"{e}\n{USAGE}")
 
@@ -59,6 +71,11 @@ def parse_options(argv: Optional[List[str]] = None) -> Dict[str, object]:
             cli["port"] = int(arg)
         elif flag == "-s":
             cli["size"] = int(arg)
+        elif flag == "--shards":
+            cli["shards"] = int(arg)
+        elif flag == "--shard-worker":
+            # internal: spawned by the shard supervisor, never by hand
+            cli["shardWorker"] = int(arg)
         elif flag == "-v":
             verbosity += 1
         elif flag == "-h":

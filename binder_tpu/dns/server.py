@@ -420,7 +420,8 @@ class DnsServer:
     _UDP_BURST = 128
 
     async def listen_udp(self, address: str, port: int,
-                         announce: bool = True) -> int:
+                         announce: bool = True,
+                         reuse_port: bool = False) -> int:
         """Direct add_reader recv/send loop.
 
         asyncio's DatagramTransport costs ~15µs/packet in protocol
@@ -440,7 +441,13 @@ class DnsServer:
         # no SO_REUSEADDR: UDP has no TIME_WAIT to work around, and on
         # Linux the option would let another local process bind a
         # more-specific address on the same port and divert queries
-        # (the reason asyncio removed it for datagram endpoints)
+        # (the reason asyncio removed it for datagram endpoints).
+        # SO_REUSEPORT is the deliberate exception — shard mode binds N
+        # worker sockets on ONE port so the kernel's 4-tuple hash
+        # balances queries across processes (same-UID only, so the
+        # hijack concern above does not apply).
+        if reuse_port:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         # absorb bursts while the event loop is busy with other work
         try:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
@@ -656,12 +663,18 @@ class DnsServer:
     _ACCEPT_BURST = 64
 
     async def listen_tcp(self, address: str, port: int,
-                         announce: bool = True) -> int:
+                         announce: bool = True,
+                         reuse_port: bool = False) -> int:
         loop = asyncio.get_running_loop()
         fam = socket.AF_INET6 if ":" in address else socket.AF_INET
         lsock = socket.socket(fam, socket.SOCK_STREAM)
         try:
             lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            if reuse_port:
+                # shard mode: the kernel spreads incoming connections
+                # across every worker listening on this port
+                lsock.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEPORT, 1)
             lsock.setblocking(False)
             lsock.bind((address, port))
             lsock.listen(1024)

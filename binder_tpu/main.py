@@ -68,10 +68,18 @@ def make_store(options: Dict[str, object], log: logging.Logger,
     raise ConfigError(f"unknown store backend: {backend}")
 
 
-async def run(options: Dict[str, object]) -> BinderServer:
+async def run_supervisor(options: Dict[str, object]):
+    """Shard mode (``--shards N``): this process is the mirror OWNER —
+    it holds the one store session, fans mutations out to N serving
+    workers over per-shard socketpair mutation logs, respawns crashes,
+    drains on SIGTERM, and aggregates metrics/status.  It serves no
+    queries itself; the kernel balances those across the workers'
+    SO_REUSEPORT sockets (binder_tpu/shard, docs/operations.md)."""
+    from binder_tpu.shard import ShardSupervisor
+
     log = make_logger(NAME, str(options.get("logLevel", os.environ.get(
         "LOG_LEVEL", "info"))))
-    log_event(log, logging.INFO, "starting with options", options={
+    log_event(log, logging.INFO, "starting shard supervisor", options={
         k: v for k, v in options.items() if k != "store"})
 
     port = int(options["port"])
@@ -91,6 +99,113 @@ async def run(options: Dict[str, object]) -> BinderServer:
         capacity=int(options.get("flightRecorderSize", 512)), log=log)
     store = make_store(options, log, collector=collector,
                        recorder=recorder)
+    cache = MirrorCache(store, str(options["dnsDomain"]), log=log,
+                        collector=collector, recorder=recorder)
+    supervisor = ShardSupervisor(options=options, store=store,
+                                 cache=cache, collector=collector,
+                                 recorder=recorder, log=log, name=NAME)
+    await supervisor.start()
+
+    loop = asyncio.get_running_loop()
+
+    def on_sigterm():
+        log.info("caught SIGTERM; draining %d shard(s)", supervisor.n)
+
+        async def _drain():
+            await supervisor.drain()
+            os._exit(0)
+
+        loop.create_task(_drain())
+
+    loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+
+    # chaos (supervisor-side): store faults and watch storms hit the
+    # owner mirror and propagate down every mutation log; shard-kill
+    # SIGKILLs a worker mid-load; stream faults drive the shared
+    # reuseport TCP port (whichever worker the kernel picks)
+    chaos_cfg = options.get("chaos")
+    if chaos_cfg:
+        from binder_tpu.chaos import ChaosDriver, FaultPlan
+        from binder_tpu.store.cache import domain_to_path
+        plan = FaultPlan.parse(str(chaos_cfg.get("plan", "")),
+                               seed=int(chaos_cfg.get("seed", 0)))
+        domain = str(options["dnsDomain"])
+
+        def chaos_mutate(i: int) -> None:
+            store.put_json(
+                domain_to_path(f"chaos{i % 8}.{domain}"),
+                {"type": "host",
+                 "host": {"address": f"10.254.{i % 8}.{i % 250 + 1}"}})
+
+        chaos_host = str(options.get("host", "0.0.0.0"))
+        if chaos_host in ("0.0.0.0", "::"):
+            chaos_host = "127.0.0.1"
+        driver = ChaosDriver(
+            plan, store=store,
+            mutate=chaos_mutate if hasattr(store, "put_json") else None,
+            tcp_target=(chaos_host, supervisor.tcp_port,
+                        f"chaos0.{domain}"),
+            shard_target=supervisor.kill_shard,
+            recorder=recorder, log=log)
+        supervisor.chaos_driver = driver
+        driver.start()
+        log.warning("chaos: FaultPlan armed (%d scheduled action(s), "
+                    "%.1fs)", len(plan.timeline), plan.duration)
+
+    watchdog = LoopLagWatchdog(collector=collector, recorder=recorder)
+    watchdog.start()
+    metrics.status_source = supervisor.snapshot
+    recorder.install_sigusr2(loop, path=options.get("flightRecorderDump"))
+    supervisor.watchdog = watchdog
+    supervisor.metrics = metrics
+    log.info("done with binder init (shard supervisor)")
+    return supervisor
+
+
+async def run(options: Dict[str, object]) -> BinderServer:
+    shard_worker = options.get("shardWorker")
+    if shard_worker is None and int(options.get("shards") or 0) >= 1:
+        return await run_supervisor(options)
+
+    log = make_logger(NAME, str(options.get("logLevel", os.environ.get(
+        "LOG_LEVEL", "info"))))
+    log_event(log, logging.INFO, "starting with options", options={
+        k: v for k, v in options.items() if k != "store"})
+
+    port = int(options["port"])
+    collector = MetricsCollector(static_labels={
+        "datacenter": options.get("datacenterName"),
+        "instance": options.get("instance_uuid"),
+        "server": options.get("server_uuid"),
+        "service": options.get("service_name"),
+        "port": port,
+    })
+    # a shard worker's scrape endpoint is per-process (ephemeral port,
+    # reported to the supervisor in the hello frame); the well-known
+    # port+1000 belongs to the supervisor's aggregated view
+    metrics = MetricsServer(collector, address="0.0.0.0",
+                            port=(0 if shard_worker is not None
+                                  else port + 1000 if port else 0))
+    metrics.start()
+    log.info("metrics server started on port %d", metrics.port)
+
+    recorder = FlightRecorder(
+        capacity=int(options.get("flightRecorderSize", 512)), log=log)
+    if shard_worker is not None:
+        # shard worker: NO store session of its own — the one session
+        # lives in the supervisor; this process replays the mutation
+        # log (snapshot now, deltas once the loop runs)
+        from binder_tpu.shard import ReplicaStore
+        from binder_tpu.shard.protocol import SHARD_FD_ENV
+        fd = int(os.environ[SHARD_FD_ENV])
+        store = ReplicaStore.from_fd(fd, int(shard_worker),
+                                     recorder=recorder, log=log)
+        nodes = store.read_snapshot()
+        log.info("shard %d: snapshot applied (%d node(s))",
+                 shard_worker, nodes)
+    else:
+        store = make_store(options, log, collector=collector,
+                           recorder=recorder)
     cache = MirrorCache(store, str(options["dnsDomain"]), log=log,
                         collector=collector, recorder=recorder)
 
@@ -115,7 +230,8 @@ async def run(options: Dict[str, object]) -> BinderServer:
         )
         await recursion.wait_ready()
 
-    balancer_socket = options.get("balancerSocket")
+    balancer_socket = (None if shard_worker is not None
+                       else options.get("balancerSocket"))
     if balancer_socket:
         # clear any stale socket; unlink on SIGTERM so the balancer stops
         # routing to us (main.js:181-199)
@@ -159,14 +275,21 @@ async def run(options: Dict[str, object]) -> BinderServer:
         # ({"enabled": false} turns one off)
         degradation=dict(options.get("degradation") or {}),
         admission=dict(options.get("admission") or {}),
+        # shard workers share ONE port via SO_REUSEPORT (the kernel
+        # balances) and leave the canonical announce lines to the
+        # supervisor, which prints them once the whole group serves
+        reuse_port=shard_worker is not None,
+        announce=shard_worker is None,
     )
     await server.start()
 
     # fault injection (chaos) — ONLY when configured, for soaks and the
     # bench's degraded axis: a scripted FaultPlan drives session loss /
     # watch storms / loop stalls inside the live process
-    # (binder_tpu/chaos, docs/degradation.md)
-    chaos_cfg = options.get("chaos")
+    # (binder_tpu/chaos, docs/degradation.md).  In shard mode the
+    # supervisor owns chaos (it has the store and the kill switch).
+    chaos_cfg = None if shard_worker is not None \
+        else options.get("chaos")
     if chaos_cfg:
         from binder_tpu.chaos import ChaosDriver, FaultPlan
         from binder_tpu.store.cache import domain_to_path
@@ -219,9 +342,61 @@ async def run(options: Dict[str, object]) -> BinderServer:
     server.watchdog = watchdog          # keep handles for shutdown /
     server.introspector = introspector  # debugging sessions
 
+    if shard_worker is not None:
+        _wire_shard_worker(server, store, metrics, collector,
+                           int(shard_worker), loop, log)
+
     log.info("done with binder init")
     server.metrics = metrics  # keep a handle for shutdown
     return server
+
+
+def _wire_shard_worker(server: BinderServer, store, metrics, collector,
+                       shard: int, loop, log: logging.Logger) -> None:
+    """Post-start plumbing for a shard worker: switch the mutation log
+    to event-loop delta reading, report hello (pid + bound ports) to
+    the supervisor, start the 1 Hz stats feed, drain on SIGTERM, and
+    die if the supervisor link ever drops (an orphan worker would
+    serve a silently aging mirror forever — the exact failure this
+    architecture exists to avoid)."""
+    from binder_tpu.shard import protocol
+
+    def link_down():
+        log.error("shard %d: supervisor gone; exiting", shard)
+        os._exit(1)
+
+    store.on_link_down = link_down
+    store.start(loop)
+    store.send(protocol.hello_frame(
+        shard, os.getpid(), server.udp_port, server.tcp_port,
+        metrics.port))
+    requests = collector.counter("binder_requests_completed")
+
+    async def stats_loop():
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                collector.fold()   # natively counted serves included
+                store.send(protocol.stats_frame(
+                    requests.total(), server.zk_cache.gen,
+                    server.zk_cache.epoch, server.zk_cache.is_ready(),
+                    len(server.engine.inflight)))
+            except Exception:
+                log.exception("shard stats report failed")
+
+    server._shard_stats_task = loop.create_task(stats_loop())
+
+    def on_sigterm():
+        log.info("shard %d: caught SIGTERM; draining", shard)
+
+        async def _drain():
+            await server.stop()
+            metrics.stop()
+            os._exit(0)
+
+        loop.create_task(_drain())
+
+    loop.add_signal_handler(signal.SIGTERM, on_sigterm)
 
 
 def main(argv=None) -> None:

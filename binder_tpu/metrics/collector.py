@@ -82,6 +82,12 @@ class Counter:
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_labels_key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label set — the per-shard stats reports use
+        this for requests-completed without building an exposition."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self, static: Tuple[Tuple[str, str], ...] = ()) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} counter"]
@@ -263,6 +269,17 @@ class MetricsCollector:
 
     def get(self, name: str):
         return self._collectors.get(name)
+
+    def fold(self) -> None:
+        """Run the pre-scrape fold hooks WITHOUT building exposition
+        text — how a shard worker keeps its natively counted serves
+        current in the 1 Hz stats frames it sends the supervisor."""
+        for fn in self._expose_hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a fold bug must not
+                logging.getLogger("binder.metrics").exception(
+                    "fold hook %r failed", fn)   # stop the stats loop
 
     def expose(self) -> str:
         for fn in self._expose_hooks:

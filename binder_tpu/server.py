@@ -160,7 +160,9 @@ class BinderServer:
                  probes: Optional[ProbeProvider] = None,
                  flight_recorder=None,
                  degradation: Optional[dict] = None,
-                 admission: Optional[dict] = None) -> None:
+                 admission: Optional[dict] = None,
+                 reuse_port: bool = False,
+                 announce: bool = True) -> None:
         self.log = log or logging.getLogger("binder.server")
         # introspection flight recorder (binder_tpu/introspect):
         # slow-query events from the after hook and lane, resolver
@@ -168,6 +170,12 @@ class BinderServer:
         self.recorder = flight_recorder
         self.host = host
         self.port = port
+        # shard mode (binder_tpu/shard): N workers bind ONE port via
+        # SO_REUSEPORT and the supervisor owns the canonical "service
+        # started" announce lines — workers keep quiet so harnesses
+        # never latch onto a group still forming
+        self.reuse_port = reuse_port
+        self.announce = announce
         self.dns_domain = dns_domain
         self.balancer_socket = balancer_socket
         self.collector = collector or MetricsCollector()
@@ -1871,7 +1879,8 @@ class BinderServer:
             # connection-refused failure)
             try:
                 udp_port = await self.engine.listen_udp(
-                    self.host, self.port, announce=False)
+                    self.host, self.port, announce=False,
+                    reuse_port=self.reuse_port)
             except OSError:
                 # a UDP bind failure (fixed port taken) must release
                 # the balancer listener opened above, like the TCP path
@@ -1880,7 +1889,7 @@ class BinderServer:
             try:
                 self.tcp_port = await self.engine.listen_tcp(
                     self.host, self.port if self.port else udp_port,
-                    announce=False)
+                    announce=False, reuse_port=self.reuse_port)
             except OSError as e:
                 # the failed draw must be released even when re-raising:
                 # callers treat start() as atomic and won't stop() a
@@ -1898,8 +1907,9 @@ class BinderServer:
                 await self.engine.close()
                 raise
             self.udp_port = udp_port
-            self.engine.announce_udp(self.host, udp_port)
-            self.engine.announce_tcp(self.host, self.tcp_port)
+            if self.announce:
+                self.engine.announce_udp(self.host, udp_port)
+                self.engine.announce_tcp(self.host, self.tcp_port)
             break
         if self._log_ring and self._log_flush_task is None:
             # periodic drain for the lanes without a C drain loop of

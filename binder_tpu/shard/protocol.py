@@ -1,0 +1,105 @@
+"""Shard mutation-log framing: the supervisor <-> worker wire format.
+
+One UNIX ``socketpair`` per shard carries two ordered streams:
+
+- supervisor -> worker: the **mutation log** — a snapshot of the owner
+  mirror (``node`` frames for every mirrored name, bracketed by a
+  ``state`` frame and ``snap-end``) followed by an endless delta feed
+  (``node`` upserts / ``gone`` removals, emitted from the owner
+  MirrorCache's per-name invalidation events) plus periodic session
+  ``state`` heartbeats.  Replaying this stream against a fresh
+  :class:`~binder_tpu.shard.replica.ReplicaStore` reproduces the
+  owner's mirror exactly — which is why a respawned shard catches up
+  by simply reading from the top (snapshot + replay on attach).
+- worker -> supervisor: one ``hello`` after the serve stack is up
+  (pid + bound ports), then 1 Hz ``stats`` frames the supervisor folds
+  into the aggregated ``binder_shard_*`` metrics and ``/status``.
+
+Framing is 4-byte big-endian length + UTF-8 JSON.  Node data rides as
+the owner mirror's *parsed* JSON (re-serialized), not raw znode bytes:
+the mirror is the source of truth in shard mode, so every worker
+converges to the owner's view even for znodes whose bytes never parsed.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+#: protocol version, carried in the state frame so a mixed-version
+#: supervisor/worker pair fails loudly instead of misapplying frames
+SHARD_PROTO_VERSION = 1
+
+#: env var carrying the worker's inherited socketpair fd
+SHARD_FD_ENV = "BINDER_SHARD_FD"
+
+#: hard cap on one frame (a 1M-name snapshot ships as many small
+#: frames, never one big one; anything larger is a corrupt stream)
+MAX_FRAME = 16 << 20
+
+
+def encode_frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME:
+        raise ValueError(f"shard frame over {MAX_FRAME} bytes")
+    return len(body).to_bytes(4, "big") + body
+
+
+def decode_frames(buf: bytearray) -> List[dict]:
+    """Consume every complete frame from *buf* (in place); partial
+    tails stay buffered for the next read."""
+    out: List[dict] = []
+    off = 0
+    n = len(buf)
+    while n - off >= 4:
+        ln = int.from_bytes(buf[off:off + 4], "big")
+        if ln > MAX_FRAME:
+            raise ValueError(f"shard frame length {ln} over cap")
+        if n - off - 4 < ln:
+            break
+        out.append(json.loads(bytes(buf[off + 4:off + 4 + ln])))
+        off += 4 + ln
+    del buf[:off]
+    return out
+
+
+def node_frame(domain: str, data) -> dict:
+    """Upsert one mirrored name (data = the mirror's parsed JSON or
+    None for a data-less node)."""
+    return {"op": "node", "d": domain, "data": data}
+
+
+def gone_frame(domain: str) -> dict:
+    return {"op": "gone", "d": domain}
+
+
+def state_frame(state: str, connected: bool,
+                disconnected_s: Optional[float],
+                establishments: int) -> dict:
+    return {"op": "state", "v": SHARD_PROTO_VERSION, "state": state,
+            "connected": connected, "disc_s": disconnected_s,
+            "est": establishments}
+
+
+def snap_end_frame(nodes: int) -> dict:
+    return {"op": "snap-end", "nodes": nodes}
+
+
+def hello_frame(shard: int, pid: int, udp_port: int, tcp_port: int,
+                metrics_port: int) -> dict:
+    return {"op": "hello", "shard": shard, "pid": pid,
+            "udp_port": udp_port, "tcp_port": tcp_port,
+            "metrics_port": metrics_port}
+
+
+def stats_frame(requests: float, gen: int, epoch: int, ready: bool,
+                inflight: int) -> dict:
+    return {"op": "stats", "requests": requests, "gen": gen,
+            "epoch": epoch, "ready": ready, "inflight": inflight}
+
+
+def snapshot_order(domains) -> List[str]:
+    """Parents before children (fewer labels first): the replica's
+    ``mkdirp`` would create missing parents anyway, but applying in
+    tree order means every parent's data lands before its children
+    fire the parent's children-watch."""
+    return sorted(domains, key=lambda d: (d.count("."), d))

@@ -1,0 +1,612 @@
+"""ShardSupervisor: one mirror owner fanning out to N serving shards.
+
+The reference's entire scaling story is N identical single-threaded
+processes behind a balancer (PAPER.md L1); ZDNS (arXiv:2309.13495)
+makes the same shared-nothing argument for DNS throughput.  This is the
+rebuild's version of that story with two deliberate twists:
+
+- **Kernel-balanced sockets.**  Every worker binds the SAME UDP+TCP
+  port with ``SO_REUSEPORT``; the kernel's 4-tuple hash spreads
+  clients across shards with zero balancer hops on the hot path.  A
+  dead worker's socket leaves the reuseport group at once, so its
+  share re-hashes to the survivors while the supervisor respawns it.
+- **One mirror owner.**  Only the supervisor holds the ZK session and
+  the store mirror, no matter how many shards serve — N shards never
+  multiply the watch load on the ensemble.  Mutations fan out over a
+  per-shard UNIX socketpair mutation log (``shard/protocol.py``):
+  snapshot + replay on attach, per-name deltas from the owner
+  MirrorCache's invalidation events afterwards.  Each worker's
+  precompiler re-renders from that same delta feed, so shard answers
+  stay byte-identical (modulo ID/rotation) to the single-process path.
+
+The supervisor also owns the operational surface: it respawns crashed
+shards (exponential backoff, snapshot catch-up), drains on SIGTERM
+(TERM to workers, bounded wait, KILL stragglers — no orphan PIDs), and
+aggregates ``/status`` + Prometheus metrics across shards (the
+``binder_shard_*`` family, one ``shard`` label per series; each
+worker's own metrics endpoint stays reachable for drill-down — its
+port is in the supervisor snapshot).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from binder_tpu.introspect.status import Introspector
+from binder_tpu.shard import protocol
+
+#: a worker whose stats are older than this is reported down
+#: (binder_shard_up 0) even if its PID still exists
+STALE_REPORT_S = 5.0
+
+#: respawn backoff: 0.25 * 2^consecutive_failures, capped
+RESPAWN_BACKOFF_MAX_S = 5.0
+
+#: per-link outbound cap: a worker that stops draining its mutation
+#: log this far behind is wedged — kill it and let snapshot catch-up
+#: do its job (bounded memory beats an unbounded replay queue)
+MAX_LINK_BUFFER = 256 << 20
+
+SUPERVISOR_SNAPSHOT_VERSION = 1
+
+
+class ShardLink:
+    """Supervisor-side state for one worker incarnation."""
+
+    __slots__ = ("shard", "proc", "sock", "wbuf", "writer_armed",
+                 "hello", "stats", "stats_at", "last_requests",
+                 "spawned_mono", "rbuf", "closed")
+
+    def __init__(self, shard: int, proc: subprocess.Popen,
+                 sock: socket.socket) -> None:
+        self.shard = shard
+        self.proc = proc
+        self.sock = sock
+        self.wbuf = bytearray()
+        self.rbuf = bytearray()
+        self.writer_armed = False
+        self.hello: Optional[dict] = None
+        self.stats: Optional[dict] = None
+        self.stats_at = 0.0
+        # last raw requests figure this incarnation reported, for the
+        # monotonic fold into binder_shard_requests across respawns
+        self.last_requests = 0.0
+        self.spawned_mono = time.monotonic()
+        self.closed = False
+
+
+class ShardSupervisor:
+    def __init__(self, *, options: Dict[str, object], store, cache,
+                 collector, recorder=None,
+                 log: Optional[logging.Logger] = None,
+                 name: str = "binder") -> None:
+        self.options = options
+        self.store = store
+        self.cache = cache
+        self.collector = collector
+        self.recorder = recorder
+        self.log = log or logging.getLogger("binder.shard")
+        self.name = name
+        self.n = max(1, int(options.get("shards") or 1))
+        self.host = str(options.get("host", "0.0.0.0"))
+        self.port = int(options.get("port", 0))
+        # resolved by shard 0's hello when the configured port is 0
+        self.udp_port: Optional[int] = self.port or None
+        self.tcp_port: Optional[int] = None
+        self.links: Dict[int, ShardLink] = {}
+        self.respawns: Dict[int, int] = {i: 0 for i in range(self.n)}
+        self._consec_fail: Dict[int, int] = {i: 0 for i in range(self.n)}
+        self._respawn_at: Dict[int, float] = {}
+        self._requests_total: Dict[int, float] = {}
+        self._hello_futs: Dict[int, asyncio.Future] = {}
+        self._draining = False
+        self._tick_task: Optional[asyncio.Task] = None
+        self._tmpdir: Optional[str] = None
+        self._cfg_path: Optional[str] = None
+        self._last_state: Optional[tuple] = None
+        self._rng = random.Random()
+        self.started_mono = time.monotonic()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._register_metrics()
+        # the owner mirror's per-name invalidation events ARE the
+        # mutation log: every tag maps to a node upsert or removal
+        cache.on_invalidate(self._on_invalidate)
+
+    # -- metrics: the binder_shard_* family (docs/observability.md) --
+
+    def _register_metrics(self) -> None:
+        c = self.collector
+        c.gauge("binder_shards",
+                "configured shard (worker process) count"
+                ).set_function(lambda: float(self.n))
+        self._respawn_children = {}
+        self._request_children = {}
+        up = c.gauge("binder_shard_up",
+                     "1 when the shard process is alive and reporting")
+        pid = c.gauge("binder_shard_pid",
+                      "PID of the shard's current incarnation")
+        gen = c.gauge("binder_shard_generation",
+                      "shard-local mirror mutation generation")
+        ready = c.gauge("binder_shard_ready",
+                        "1 when the shard's replica mirror is ready")
+        respawns = c.counter("binder_shard_respawns",
+                             "times the supervisor respawned a crashed "
+                             "shard")
+        requests = c.counter("binder_shard_requests",
+                             "requests completed per shard (folded "
+                             "monotonically across respawns)")
+        for i in range(self.n):
+            labels = {"shard": str(i)}
+            up.set_function(lambda i=i: self._up(i), labels)
+            pid.set_function(lambda i=i: float(self._pid(i) or 0),
+                             labels)
+            gen.set_function(lambda i=i: self._stat(i, "gen"), labels)
+            ready.set_function(lambda i=i: self._stat(i, "ready"),
+                               labels)
+            rc = respawns.labelled(labels)
+            rc.inc(0)
+            self._respawn_children[i] = rc
+            qc = requests.labelled(labels)
+            qc.inc(0)
+            self._request_children[i] = qc
+
+    def _up(self, i: int) -> float:
+        link = self.links.get(i)
+        if link is None or link.proc.poll() is not None:
+            return 0.0
+        if link.hello is None:
+            return 0.0
+        if time.monotonic() - link.stats_at > STALE_REPORT_S \
+                and link.stats is not None:
+            return 0.0
+        return 1.0
+
+    def _pid(self, i: int) -> Optional[int]:
+        link = self.links.get(i)
+        return None if link is None else link.proc.pid
+
+    def _stat(self, i: int, key: str) -> float:
+        link = self.links.get(i)
+        if link is None or link.stats is None:
+            return 0.0
+        return float(link.stats.get(key) or 0)
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        """Spawn shard 0 first (it resolves an ephemeral port draw for
+        the whole reuseport group), then the rest concurrently."""
+        self._loop = asyncio.get_running_loop()
+        self._tmpdir = tempfile.mkdtemp(prefix="binder-shards-")
+        self._spawn(0, self.port)
+        hello = await self._wait_hello(0)
+        self.udp_port = int(hello["udp_port"])
+        self.tcp_port = int(hello["tcp_port"])
+        for i in range(1, self.n):
+            self._spawn(i, self.udp_port)
+        if self.n > 1:
+            await asyncio.gather(*[self._wait_hello(i)
+                                   for i in range(1, self.n)])
+        self._tick_task = self._loop.create_task(self._tick_loop())
+        self.log.info("all %d shard(s) serving (pids %s)", self.n,
+                      ",".join(str(self._pid(i)) for i in
+                               range(self.n)))
+        # the canonical "service started" lines, printed ONCE the whole
+        # group is up — harnesses key on these exact formats, and a
+        # worker's own announce would advertise a group still forming
+        self.log.info("UDP DNS service started on %s:%d", self.host,
+                      self.udp_port)
+        self.log.info("TCP DNS service started on %s:%d", self.host,
+                      self.tcp_port)
+
+    async def _wait_hello(self, i: int, timeout: float = 30.0) -> dict:
+        link = self.links[i]
+        if link.hello is not None:
+            return link.hello
+        fut = self._loop.create_future()
+        self._hello_futs[i] = fut
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._hello_futs.pop(i, None)
+
+    def _worker_config(self, port: int) -> str:
+        """Write the resolved worker config once per port draw.  The
+        store block is STRIPPED — a worker must never open its own
+        store session (that is the whole point of the owner) — and so
+        are the supervisor-only knobs."""
+        if self._cfg_path is not None:
+            return self._cfg_path
+        opts = {k: v for k, v in self.options.items()
+                if k not in ("shards", "chaos", "store",
+                             "balancerSocket", "configFile",
+                             "shardWorker")}
+        opts["port"] = port
+        path = os.path.join(self._tmpdir, "worker-config.json")
+        with open(path, "w") as f:
+            json.dump(opts, f)
+        if port:
+            self._cfg_path = path
+        return path
+
+    def _spawn(self, i: int, port: int) -> None:
+        parent, child = socket.socketpair(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+        argv = [sys.executable, "-u", "-m", "binder_tpu.main",
+                "-f", self._worker_config(port),
+                "--shard-worker", str(i)]
+        env = dict(os.environ)
+        env[protocol.SHARD_FD_ENV] = str(child.fileno())
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            proc = subprocess.Popen(argv, pass_fds=(child.fileno(),),
+                                    env=env)
+        finally:
+            child.close()
+        parent.setblocking(False)
+        link = ShardLink(i, proc, parent)
+        self.links[i] = link
+        self._loop.add_reader(parent.fileno(), self._on_worker_readable,
+                              link)
+        # attach-time snapshot: the worker replays this, then the
+        # delta feed continues seamlessly on the same ordered stream
+        self._send_snapshot(link)
+        self.log.info("shard %d spawned (pid %d)", i, proc.pid)
+        if self.recorder is not None:
+            self.recorder.record("shard-spawn", shard=i, pid=proc.pid,
+                                 respawns=self.respawns[i])
+
+    # -- mutation-log fanout --
+
+    def _state_tuple(self) -> tuple:
+        st = self.store
+        state = getattr(st, "session_state",
+                        lambda: "connected" if st.is_connected()
+                        else "never-connected")()
+        disc = getattr(st, "disconnected_seconds", lambda: None)()
+        est = getattr(st, "session_establishments", 0)
+        return (state, bool(st.is_connected()), disc, est)
+
+    def _state_frame(self) -> dict:
+        state, connected, disc, est = self._state_tuple()
+        return protocol.state_frame(state, connected, disc, est)
+
+    def _send_snapshot(self, link: ShardLink) -> None:
+        frames = [self._state_frame()]
+        domains = protocol.snapshot_order(self.cache.nodes)
+        for d in domains:
+            node = self.cache.nodes.get(d)
+            if node is not None:
+                frames.append(protocol.node_frame(d, node.data))
+        frames.append(protocol.snap_end_frame(len(domains)))
+        for frame in frames:
+            self._send(link, frame)
+
+    def _on_invalidate(self, tags) -> None:
+        """Owner-mirror invalidation -> delta frames.  Tags are lookup
+        domains and PTR qnames; only forward names under the served
+        domain map to mirrored nodes (workers rebuild their own
+        reverse index from node data)."""
+        if not self.links:
+            return
+        domain = self.cache.domain
+        suffix = "." + domain
+        frames = []
+        for tag in tags:
+            if tag != domain and not tag.endswith(suffix):
+                continue
+            node = self.cache.lookup(tag)
+            frames.append(protocol.node_frame(tag, node.data)
+                          if node is not None
+                          else protocol.gone_frame(tag))
+        if not frames:
+            return
+        for link in list(self.links.values()):
+            for frame in frames:
+                self._send(link, frame)
+
+    def _send(self, link: ShardLink, frame: dict) -> None:
+        if link.closed:
+            return
+        link.wbuf.extend(protocol.encode_frame(frame))
+        if len(link.wbuf) > MAX_LINK_BUFFER:
+            # a worker this far behind on its mutation log is wedged;
+            # snapshot catch-up on respawn is the bounded recovery
+            self.log.error("shard %d: mutation log %d bytes behind; "
+                           "killing for respawn", link.shard,
+                           len(link.wbuf))
+            self.kill_shard(link.shard)
+            return
+        self._flush(link)
+
+    def _flush(self, link: ShardLink) -> None:
+        if link.closed or not link.wbuf:
+            return
+        try:
+            sent = link.sock.send(bytes(link.wbuf))
+            del link.wbuf[:sent]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            # worker died mid-write; the tick loop reaps and respawns
+            self._close_link(link)
+            return
+        if link.wbuf and not link.writer_armed:
+            link.writer_armed = True
+            self._loop.add_writer(link.sock.fileno(),
+                                  self._on_worker_writable, link)
+
+    def _on_worker_writable(self, link: ShardLink) -> None:
+        try:
+            self._loop.remove_writer(link.sock.fileno())
+        except (OSError, ValueError):
+            pass
+        link.writer_armed = False
+        self._flush(link)
+
+    # -- worker -> supervisor frames --
+
+    def _on_worker_readable(self, link: ShardLink) -> None:
+        try:
+            chunk = link.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._sever(link)
+            return
+        if not chunk:
+            self._sever(link)
+            return
+        link.rbuf.extend(chunk)
+        try:
+            frames = protocol.decode_frames(link.rbuf)
+        except ValueError:
+            self.log.error("shard %d: corrupt worker stream; killing",
+                           link.shard)
+            self.kill_shard(link.shard)
+            return
+        for frame in frames:
+            op = frame.get("op")
+            if op == "hello":
+                link.hello = frame
+                self._consec_fail[link.shard] = 0
+                self.log.info(
+                    "shard %d serving: pid %d udp %s tcp %s metrics %s",
+                    link.shard, frame.get("pid"), frame.get("udp_port"),
+                    frame.get("tcp_port"), frame.get("metrics_port"))
+                fut = self._hello_futs.get(link.shard)
+                if fut is not None and not fut.done():
+                    fut.set_result(frame)
+            elif op == "stats":
+                self._fold_stats(link, frame)
+
+    def _fold_stats(self, link: ShardLink, frame: dict) -> None:
+        link.stats = frame
+        link.stats_at = time.monotonic()
+        req = float(frame.get("requests") or 0.0)
+        # monotonic fold: a respawned incarnation restarts its counter
+        # at 0, so deltas are per-incarnation
+        delta = req - link.last_requests
+        if delta < 0:
+            delta = req
+        link.last_requests = req
+        if delta > 0:
+            self._request_children[link.shard].inc(delta)
+            self._requests_total[link.shard] = \
+                self._requests_total.get(link.shard, 0.0) + delta
+
+    def _sever(self, link: ShardLink) -> None:
+        """A dead mutation log means a dead shard: a worker that lost
+        its feed can only serve an aging mirror, so force the exit the
+        tick loop's respawn path expects."""
+        self._close_link(link)
+        if link.proc.poll() is None:
+            try:
+                link.proc.terminate()
+            except (ProcessLookupError, OSError):
+                pass
+
+    def _close_link(self, link: ShardLink) -> None:
+        if link.closed:
+            return
+        link.closed = True
+        try:
+            self._loop.remove_reader(link.sock.fileno())
+        except (OSError, ValueError):
+            pass
+        if link.writer_armed:
+            try:
+                self._loop.remove_writer(link.sock.fileno())
+            except (OSError, ValueError):
+                pass
+        try:
+            link.sock.close()
+        except OSError:
+            pass
+
+    # -- crash handling / heartbeat tick --
+
+    async def _tick_loop(self) -> None:
+        while not self._draining:
+            await asyncio.sleep(0.5)
+            try:
+                self._tick()
+            except Exception:
+                self.log.exception("shard supervisor tick failed")
+
+    def _tick(self) -> None:
+        # session-state heartbeat (edge-triggered + periodic): workers'
+        # degradation policies age on the owner's measured clock
+        state = self._state_tuple()
+        frame = protocol.state_frame(*state)
+        for link in list(self.links.values()):
+            self._send(link, frame)
+        self._last_state = state
+        if self._draining:
+            return
+        now = time.monotonic()
+        for i in range(self.n):
+            link = self.links.get(i)
+            if link is not None and link.proc.poll() is None:
+                continue
+            if link is not None:
+                # reap + schedule the respawn with backoff
+                rc = link.proc.poll()
+                self._close_link(link)
+                del self.links[i]
+                self.respawns[i] += 1
+                self._respawn_children[i].inc()
+                self._consec_fail[i] += 1
+                backoff = min(RESPAWN_BACKOFF_MAX_S,
+                              0.25 * (2 ** (self._consec_fail[i] - 1)))
+                self._respawn_at[i] = now + backoff
+                self.log.warning(
+                    "shard %d (pid %d) exited rc=%s; respawning in "
+                    "%.2fs (respawn #%d)", i, link.proc.pid, rc,
+                    backoff, self.respawns[i])
+                if self.recorder is not None:
+                    self.recorder.record("shard-exit", shard=i,
+                                         pid=link.proc.pid, rc=rc,
+                                         respawns=self.respawns[i])
+                continue
+            if now >= self._respawn_at.get(i, 0.0) \
+                    and self.udp_port is not None:
+                self._spawn(i, self.udp_port)
+
+    def kill_shard(self, shard: int = -1,
+                   sig: int = signal.SIGKILL) -> Optional[int]:
+        """Kill one worker (chaos ``shard-kill``, wedged-link
+        recovery).  ``shard=-1`` picks a live one at random.  Returns
+        the killed PID (None when nothing was killable)."""
+        candidates = [lk for lk in self.links.values()
+                      if lk.proc.poll() is None]
+        if not candidates:
+            return None
+        if shard < 0:
+            link = self._rng.choice(candidates)
+        else:
+            link = self.links.get(shard)
+            if link is None or link.proc.poll() is not None:
+                return None
+        pid = link.proc.pid
+        try:
+            link.proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            return None
+        self.log.warning("shard %d: sent signal %d to pid %d",
+                         link.shard, sig, pid)
+        return pid
+
+    async def drain(self, timeout: float = 10.0) -> None:
+        """SIGTERM drain: stop respawning, TERM every worker, wait
+        bounded, KILL stragglers, reap everything — no orphan PIDs."""
+        self._draining = True
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except asyncio.CancelledError:
+                pass
+            self._tick_task = None
+        procs: List[subprocess.Popen] = []
+        for link in list(self.links.values()):
+            if link.proc.poll() is None:
+                try:
+                    link.proc.terminate()
+                except (ProcessLookupError, OSError):
+                    pass
+            procs.append(link.proc)
+        deadline = time.monotonic() + timeout
+        for proc in procs:
+            while proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            if proc.poll() is None:
+                self.log.warning("shard pid %d ignored SIGTERM; "
+                                 "killing", proc.pid)
+                try:
+                    proc.kill()
+                except (ProcessLookupError, OSError):
+                    pass
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        # links close only AFTER the workers had their SIGTERM window:
+        # closing first would race their graceful drain with the noisy
+        # link-down exit path
+        for link in list(self.links.values()):
+            self._close_link(link)
+        self.links.clear()
+        if self._tmpdir is not None:
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+        self.log.info("shard supervisor drained (%d worker(s))",
+                      len(procs))
+
+    # -- aggregated /status (served by the supervisor metrics port) --
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        workers = []
+        for i in range(self.n):
+            link = self.links.get(i)
+            hello = link.hello if link is not None else None
+            stats = link.stats if link is not None else None
+            workers.append({
+                "shard": i,
+                "pid": self._pid(i),
+                "alive": bool(link is not None
+                              and link.proc.poll() is None),
+                "up": bool(self._up(i)),
+                "state": ("serving" if self._up(i) else
+                          "starting" if link is not None else
+                          "respawning"),
+                "udp_port": hello.get("udp_port") if hello else None,
+                "tcp_port": hello.get("tcp_port") if hello else None,
+                "metrics_port": (hello.get("metrics_port")
+                                 if hello else None),
+                "respawns": self.respawns[i],
+                "requests": self._requests_total.get(i, 0.0),
+                "generation": (stats or {}).get("gen", 0),
+                "epoch": (stats or {}).get("epoch", 0),
+                "ready": bool((stats or {}).get("ready")),
+                "inflight": (stats or {}).get("inflight", 0),
+                "last_report_age_seconds": (
+                    None if link is None or not link.stats_at
+                    else now - link.stats_at),
+            })
+        intro = Introspector(zk_cache=self.cache, store=self.store,
+                             recorder=self.recorder, name=self.name)
+        return {
+            "service": {
+                "name": self.name + "-supervisor",
+                "pid": os.getpid(),
+                "version": SUPERVISOR_SNAPSHOT_VERSION,
+                "uptime_seconds": now - self.started_mono,
+                "generated_at": time.time(),
+            },
+            "store": intro._store_section(),
+            "mirror": intro._mirror_section(),
+            "shards": {
+                "count": self.n,
+                "up": sum(1 for w in workers if w["up"]),
+                "udp_port": self.udp_port,
+                "tcp_port": self.tcp_port,
+                "respawns_total": sum(self.respawns.values()),
+                "workers": workers,
+            },
+            "flight_recorder": intro._recorder_section(),
+        }

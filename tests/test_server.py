@@ -559,15 +559,16 @@ class TestPairBind:
             real_listen_udp = server.engine.listen_udp
             calls = []
 
-            async def forced_listen_udp(host, port, announce=True):
+            async def forced_listen_udp(host, port, announce=True,
+                                        **kw):
                 # first draw lands on the TCP-occupied port (what the
                 # kernel did to CI); later draws are honest
                 calls.append(port)
                 if len(calls) == 1:
                     return await real_listen_udp(host, taken,
-                                                 announce=announce)
+                                                 announce=announce, **kw)
                 return await real_listen_udp(host, port,
-                                             announce=announce)
+                                             announce=announce, **kw)
 
             server.engine.listen_udp = forced_listen_udp
             await server.start()
@@ -751,13 +752,13 @@ class TestPairBindAnnouncement:
             real_listen_udp = server.engine.listen_udp
             first = []
 
-            async def forced(host, port, announce=True):
+            async def forced(host, port, announce=True, **kw):
                 if not first:
                     first.append(True)
                     return await real_listen_udp(host, taken,
-                                                 announce=announce)
+                                                 announce=announce, **kw)
                 return await real_listen_udp(host, port,
-                                             announce=announce)
+                                             announce=announce, **kw)
 
             server.engine.listen_udp = forced
             await server.start()

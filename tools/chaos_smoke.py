@@ -245,6 +245,24 @@ def main() -> int:
     except Violation as e:
         print(json.dumps({"chaos_smoke": "FAIL", "violation": str(e)}))
         return 1
+    # shard-kill incident (ISSUE 6): the scripted multi-process phase —
+    # a SIGKILLed worker mid-load must cost nothing observable beyond a
+    # respawn (re-converged serving, monotonic mirror generation);
+    # tools/shard_smoke.py owns the harness, this wires it into the
+    # chaos gate with a proportionally short window
+    from tools.shard_smoke import Violation as ShardViolation
+    from tools.shard_smoke import run_shard_incident
+    duration = float(os.environ.get("BINDER_CHAOS_SECONDS", "30"))
+    try:
+        shard_stats = asyncio.run(
+            run_shard_incident(max(6.0, duration * 0.4)))
+    except ShardViolation as e:
+        print(json.dumps({"chaos_smoke": "FAIL",
+                          "violation": f"shard incident: {e}"}))
+        return 1
+    stats["shard_incident"] = {
+        k: shard_stats[k] for k in ("queries", "ok", "respawned_pid",
+                                    "requests_per_shard")}
     print(json.dumps({"chaos_smoke": "ok", **stats}))
     return 0
 

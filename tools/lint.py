@@ -767,6 +767,64 @@ def validate_tcp_metrics(text):
     return errs
 
 
+# -- shard-mode metrics (binder_tpu/shard, docs/observability.md) ------
+#
+# The supervisor aggregates N workers into the binder_shard_* family:
+# per-shard series MUST carry a `shard` label (an unlabeled sample
+# would silently sum incomparable processes in PromQL), every family
+# must have the right TYPE, and every series must exist from scrape 1
+# (the supervisor registers all N label sets at startup, so absence is
+# always an exporter bug).  Wired into tier-1 via tests/test_shards.py
+# and into `make shard-smoke`.
+
+_SHARD_FAMILIES = {
+    "binder_shards": ("gauge", False),
+    "binder_shard_up": ("gauge", True),
+    "binder_shard_pid": ("gauge", True),
+    "binder_shard_generation": ("gauge", True),
+    "binder_shard_ready": ("gauge", True),
+    "binder_shard_respawns": ("counter", True),
+    "binder_shard_requests": ("counter", True),
+}
+
+
+def validate_shard_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_shard_*`` family: correct TYPE declarations, at least one
+    sample each, and a ``shard`` label on every per-shard series.
+    Returns error strings; empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            name, _, labels = parts[0].partition("{")
+            samples.setdefault(name, []).append(labels)
+    for family, (kind, per_shard) in _SHARD_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in samples:
+            errs.append(f"{family}: no samples in exposition")
+        elif per_shard:
+            for labels in samples[family]:
+                # parse actual label NAMES ("notshard" must not pass a
+                # substring check for "shard")
+                names = {pair.partition("=")[0]
+                         for pair in labels.partition("}")[0].split(",")
+                         if pair}
+                if "shard" not in names:
+                    errs.append(f"{family}: sample missing the "
+                                f"`shard` label")
+                    break
+    return errs
+
+
 def is_python_script(path):
     if path.endswith(".py"):
         return True

@@ -1,0 +1,331 @@
+#!/usr/bin/env python3
+"""Shard-mode smoke: N=2 kernel-balanced workers + kill/respawn + parity.
+
+Boots a REAL shard supervisor subprocess (``python -m binder_tpu.main
+--shards 2`` on a fake-store fixture), then, while driving continuous
+queries over many client sockets (distinct source ports — what makes
+``SO_REUSEPORT`` actually spread load), asserts the PR's acceptance
+invariants end to end:
+
+- both workers answer (per-shard ``binder_shard_requests`` advance),
+  behind ONE UDP port, from distinct PIDs;
+- a ``shard-kill`` chaos fault (SIGKILL mid-load, scripted through the
+  server's own chaos config block) costs no correctness: serving
+  continues on the survivor, the supervisor respawns the shard
+  (``binder_shard_respawns`` >= 1, new PID), and the respawn catches
+  up from snapshot — post-kill mutations are served by everyone;
+- the owner mirror generation is monotonic across the incident;
+- answers are identical across shards (byte parity modulo ID for
+  single-answer shapes, set parity for rotated service answers);
+- the supervisor scrape passes ``validate_shard_metrics``;
+- SIGTERM drains: the supervisor exits and leaves no orphan worker
+  PIDs.
+
+Run via ``make shard-smoke`` (30 s) or set ``BINDER_SHARD_SECONDS``.
+Prints one JSON summary line; exit 0 == all invariants held.
+"""
+import asyncio
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.dns import Message, Rcode, Type, make_query  # noqa: E402
+from tools.lint import validate_shard_metrics  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOMAIN = "shardsmoke.test"
+SHARDS = 2
+CLIENT_SOCKETS = 16
+
+FIXTURE = {
+    **{f"/test/shardsmoke/w{i}":
+       {"type": "host", "host": {"address": f"10.40.0.{i + 1}"}}
+       for i in range(8)},
+    "/test/shardsmoke/svc": {
+        "type": "service",
+        "service": {"srvce": "_http", "proto": "_tcp", "port": 80}},
+    **{f"/test/shardsmoke/svc/m{i}":
+       {"type": "host", "host": {"address": f"10.40.1.{i + 1}"}}
+       for i in range(3)},
+}
+
+
+class Violation(Exception):
+    pass
+
+
+def _scrape(mport: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def _status(mport: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/status", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _drain_stdout(proc) -> None:
+    """Keep the (non-blocking) supervisor stdout pipe empty so log
+    writes from the supervisor and its workers never block on a full
+    pipe mid-incident."""
+    try:
+        while True:
+            chunk = os.read(proc.stdout.fileno(), 65536)
+            if not chunk:
+                return
+    except (BlockingIOError, InterruptedError):
+        pass
+    except OSError:
+        pass
+
+
+def _metric(text: str, name: str, shard: int = None) -> float:
+    shard_pin = '' if shard is None else 'shard="%d"' % shard
+    pat = (r"^%s\{[^}]*%s[^}]*\} ([0-9.eE+-]+)$"
+           % (re.escape(name), shard_pin))
+    m = re.search(pat, text, re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+async def _ask_fresh(port, name, qtype, qid, timeout=2.0) -> bytes:
+    """One query on a FRESH socket (new source port -> the kernel may
+    pick either shard); retries ride the same socket so a packet lost
+    in a dying shard's queue costs a retry, not a hang."""
+    loop = asyncio.get_running_loop()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setblocking(False)
+    sock.connect(("127.0.0.1", port))
+    wire = make_query(name, qtype, qid=qid).encode()
+    try:
+        for _ in range(3):
+            sock.send(wire)
+            try:
+                return await asyncio.wait_for(
+                    loop.sock_recv(sock, 4096), timeout)
+            except asyncio.TimeoutError:
+                continue
+        raise Violation("query for %s got no answer in 3 tries" % name)
+    finally:
+        sock.close()
+
+
+async def _parity_probe(port: int, samples: int = 12) -> None:
+    """Across many fresh sockets (so both shards answer), every
+    single-answer shape must be byte-identical modulo the ID, and the
+    rotated service answer must be the same SET of addresses."""
+    for i in range(4):
+        name = f"w{i}.{DOMAIN}"
+        wires = set()
+        for s in range(samples):
+            data = await _ask_fresh(port, name, Type.A,
+                                    qid=1000 + i * 64 + s)
+            wires.add(b"\x00\x00" + data[2:])
+        if len(wires) != 1:
+            raise Violation(f"answer wires for {name} differ across "
+                            f"shards ({len(wires)} variants)")
+    addr_sets = set()
+    for s in range(samples):
+        data = await _ask_fresh(port, f"svc.{DOMAIN}", Type.A,
+                                qid=2000 + s)
+        msg = Message.decode(data)
+        addr_sets.add(tuple(sorted(a.address for a in msg.answers)))
+    if len(addr_sets) != 1:
+        raise Violation(f"service answer sets differ across shards: "
+                        f"{addr_sets}")
+
+
+async def run_shard_incident(duration: float) -> dict:
+    tmpdir = tempfile.mkdtemp(prefix="shard-smoke-")
+    fixture = os.path.join(tmpdir, "fixture.json")
+    config = os.path.join(tmpdir, "config.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    kill_at = max(1.5, duration * 0.35)
+    storm_at = max(2.0, duration * 0.55)
+    with open(config, "w") as f:
+        json.dump({
+            "dnsDomain": DOMAIN, "datacenterName": "dc0",
+            "host": "127.0.0.1", "queryLog": False,
+            "store": {"backend": "fake", "fixture": fixture},
+            "shards": SHARDS,
+            # the scripted incident: SIGKILL shard 0 mid-load, then a
+            # mutation burst the respawned shard must also converge on
+            "chaos": {"plan": f"at {kill_at:.1f} shard-kill shard=0; "
+                              f"at {storm_at:.1f} watch-storm n=40"},
+        }, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
+         "-p", "0"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+
+    stats = {"queries": 0, "ok": 0, "retries": 0}
+    try:
+        # wait for the supervisor's canonical announce + metrics lines
+        buf = b""
+        deadline = time.time() + 30
+        port = mport = None
+        while time.time() < deadline:
+            chunk = os.read(proc.stdout.fileno(), 4096)
+            if not chunk:
+                raise Violation("supervisor exited during startup")
+            buf += chunk
+            m = re.search(rb"UDP DNS service started on "
+                          rb"[\d.]+:(\d+)\"", buf)
+            if m:
+                port = int(m.group(1))
+                mm = re.search(
+                    rb"metrics server started on port (\d+)\"", buf)
+                mport = int(mm.group(1)) if mm else None
+                break
+        if port is None or mport is None:
+            raise Violation("supervisor did not report its ports")
+        os.set_blocking(proc.stdout.fileno(), False)
+
+        snap = _status(mport)
+        pids0 = [w["pid"] for w in snap["shards"]["workers"]]
+        if len(set(pids0)) != SHARDS:
+            raise Violation(f"expected {SHARDS} distinct worker pids, "
+                            f"got {pids0}")
+
+        gen_seen = -1
+        killed_pid = pids0[0]
+        t_end = time.monotonic() + duration
+        i = 0
+        while time.monotonic() < t_end:
+            i += 1
+            name = f"w{i % 8}.{DOMAIN}"
+            stats["queries"] += 1
+            data = await _ask_fresh(port, name, Type.A,
+                                    qid=(i % 0xFFFF) + 1)
+            msg = Message.decode(data)
+            if msg.rcode != Rcode.NOERROR or not msg.answers:
+                raise Violation(f"bad answer for {name}: "
+                                f"rcode {msg.rcode}")
+            if msg.answers[0].address != f"10.40.0.{i % 8 + 1}":
+                raise Violation(f"wrong address for {name}: "
+                                f"{msg.answers[0].address}")
+            stats["ok"] += 1
+            if i % 29 == 0:
+                _drain_stdout(proc)
+                snap = _status(mport)
+                gen = snap["mirror"]["generation"]
+                if gen < gen_seen:
+                    raise Violation(f"mirror generation regressed "
+                                    f"{gen_seen} -> {gen}")
+                gen_seen = gen
+            await asyncio.sleep(duration / 1500.0)
+
+        # -- post-incident assertions --
+        _drain_stdout(proc)
+        text = _scrape(mport)
+        errs = validate_shard_metrics(text)
+        if errs:
+            raise Violation(f"shard metrics: {errs[:3]}")
+        if _metric(text, "binder_shard_respawns", 0) < 1:
+            raise Violation("killed shard was never respawned")
+        snap = _status(mport)
+        workers = snap["shards"]["workers"]
+        if snap["shards"]["up"] != SHARDS:
+            raise Violation(f"{snap['shards']['up']}/{SHARDS} shards "
+                            f"up after incident")
+        new_pid = workers[0]["pid"]
+        if new_pid == killed_pid:
+            raise Violation("shard 0 pid unchanged after SIGKILL")
+        for w in workers:
+            if w["requests"] <= 0:
+                raise Violation(f"shard {w['shard']} answered no "
+                                f"queries (reuseport never spread?)")
+
+        # snapshot catch-up: the storm's final ring state must be
+        # served by EVERY shard (fresh sockets hit both)
+        final = {f"chaos{i % 8}": f"10.254.{i % 8}.{i % 250 + 1}"
+                 for i in range(40)}
+        for label, addr in sorted(final.items()):
+            for s in range(6):
+                data = await _ask_fresh(port, f"{label}.{DOMAIN}",
+                                        Type.A, qid=3000 + s)
+                msg = Message.decode(data)
+                if not msg.answers or msg.answers[0].address != addr:
+                    raise Violation(
+                        f"post-respawn {label} served "
+                        f"{msg.answers[0].address if msg.answers else None}"
+                        f", want {addr}")
+        await _parity_probe(port)
+
+        # -- SIGTERM drain: no orphan worker PIDs --
+        all_pids = [w["pid"] for w in workers]
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            raise Violation("supervisor did not exit on SIGTERM")
+        deadline = time.monotonic() + 5
+        orphans = list(all_pids)
+        while orphans and time.monotonic() < deadline:
+            orphans = [p for p in orphans if _pid_alive(p)]
+            await asyncio.sleep(0.1)
+        if orphans:
+            raise Violation(f"orphan worker pid(s) after drain: "
+                            f"{orphans}")
+        stats.update({
+            "duration_s": duration,
+            "shards": SHARDS,
+            "pids_before": pids0,
+            "respawned_pid": new_pid,
+            "requests_per_shard": {w["shard"]: w["requests"]
+                                   for w in workers},
+            "mirror_generation": gen_seen,
+        })
+        return stats
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def run_smoke(duration: float = None) -> dict:
+    if duration is None:
+        duration = float(os.environ.get("BINDER_SHARD_SECONDS", "30"))
+    return asyncio.run(run_shard_incident(duration))
+
+
+def main() -> int:
+    try:
+        stats = run_smoke()
+    except Violation as e:
+        print(json.dumps({"shard_smoke": "FAIL", "violation": str(e)}))
+        return 1
+    print(json.dumps({"shard_smoke": "ok", **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
