@@ -4,7 +4,7 @@
 PY ?= python3
 
 .PHONY: all native test check ci bench bench-smoke status-smoke \
-	chaos-smoke tcp-smoke shard-smoke real-tiers clean
+	chaos-smoke tcp-smoke shard-smoke zone-smoke real-tiers clean
 
 all: native
 
@@ -54,6 +54,7 @@ ci:
 	BINDER_CHAOS_SECONDS=10 $(MAKE) chaos-smoke
 	$(MAKE) tcp-smoke
 	BINDER_SHARD_SECONDS=10 $(MAKE) shard-smoke
+	BINDER_ZONE_NAMES=20000 $(MAKE) zone-smoke
 	@echo "ci: all gates passed"
 
 # one fast reduced-iteration bench pass proving the measured paths still
@@ -93,6 +94,18 @@ chaos-smoke:
 # overrides the duration
 shard-smoke:
 	$(PY) tools/shard_smoke.py
+
+# zone-scale smoke: build a synthetic 100k-name mirror (control: 2k),
+# apply a mutation burst + watch storm through the real mirror ->
+# invalidate -> precompile chain, and assert the million-name
+# representation's invariants: single-name rebuild latency independent
+# of zone size (O(delta)), re-rendered answers byte-identical to fresh
+# engine renders, chunked session rebuild under the loop-lag watchdog
+# threshold with serving continuing throughout, and the
+# binder_mirror_* exposition pins (docs/operations.md "Large zones");
+# BINDER_ZONE_NAMES overrides the size (make ci trims to 20k)
+zone-smoke:
+	$(PY) tools/zone_smoke.py
 
 # stream-lane end-to-end smoke: one-shot (accept fast path), pipelined
 # promotion + write coalescing, slow-reader disconnect at the

@@ -93,7 +93,22 @@ def _env_fingerprint() -> Dict[str, object]:
             "pinned": PINNED,
             "server_cores": SERVER_CORES if PINNED else all_cores,
             "client_cores": CLIENT_CORES if PINNED else all_cores,
-            "loadavg_start": load1, "passes": N_PASSES}
+            "loadavg_start": load1, "passes": N_PASSES,
+            # zone size every standard axis is measured at (ISSUE 7:
+            # a qps figure without its zone scale is uninterpretable;
+            # the zone_scale axis carries its own per-size blocks)
+            "zone": _fixture_zone()}
+
+
+def _fixture_zone() -> Dict[str, int]:
+    """Name/node counts of the standard bench fixture (the zone the
+    headline axes serve)."""
+    paths = set()
+    for p in FIXTURE:
+        parts = [x for x in p.split("/") if x]
+        for i in range(1, len(parts) + 1):
+            paths.add("/".join(parts[:i]))
+    return {"names": len(FIXTURE), "nodes": len(paths)}
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", "50000"))
 # hot-axis passes: p99 on a single shared-core box varies ±40% run to
 # run (see docs/bench.md), so the headline is the median-by-qps of
@@ -233,12 +248,13 @@ def start_server(tmpdir: str) -> subprocess.Popen:
 
 
 def _wait_for_line_buf(proc: subprocess.Popen, pattern: bytes,
-                       what: str) -> Tuple[int, bytes]:
+                       what: str, timeout: float = 30.0
+                       ) -> Tuple[int, bytes]:
     """Deadline-bounded read of proc stdout until `pattern` matches;
     returns (captured int, everything read so far).  A child that
     wedges mid-startup (or writes a partial line) must not hang the
     bench."""
-    deadline = time.time() + 30
+    deadline = time.time() + timeout
     buf = b""
     while time.time() < deadline:
         ready, _, _ = select.select([proc.stdout], [], [],
@@ -252,20 +268,22 @@ def _wait_for_line_buf(proc: subprocess.Popen, pattern: bytes,
         m = re.search(pattern, buf)
         if m:
             return int(m.group(1)), buf
-    raise RuntimeError("%s did not report its port within 30s" % what)
+    raise RuntimeError("%s did not report its port within %.0fs"
+                       % (what, timeout))
 
 
 def _wait_for_line(proc: subprocess.Popen, pattern: bytes,
-                   what: str) -> int:
-    return _wait_for_line_buf(proc, pattern, what)[0]
+                   what: str, timeout: float = 30.0) -> int:
+    return _wait_for_line_buf(proc, pattern, what, timeout)[0]
 
 
-def wait_for_port(proc: subprocess.Popen) -> int:
+def wait_for_port(proc: subprocess.Popen, timeout: float = 30.0) -> int:
     # patterns must anchor past the number, or a mid-number pipe-buffer
     # split ("...:444" / "28\"...") yields a truncated port; the bunyan
     # msg is JSON, so the port is terminated by the closing quote
     return _wait_for_line(
-        proc, rb"UDP DNS service started on [\d.]+:(\d+)\"", "bench server")
+        proc, rb"UDP DNS service started on [\d.]+:(\d+)\"",
+        "bench server", timeout)
 
 
 def wait_for_ports(proc: subprocess.Popen) -> Tuple[int, int]:
@@ -1825,6 +1843,147 @@ def _bench_shard(tmpdir: str) -> Dict[str, object]:
     return out
 
 
+# -- zone_scale axis (ISSUE 7): the headline numbers at production ----
+# -- zone sizes, with the 100-name figure as the control ----
+#
+# Two phases per size.  Phase A is tools/zone_probe.py in a SUBPROCESS
+# (mirror build time / RSS-per-name / single-name mutation latency /
+# watch-storm recovery / chunked-rebuild loop lag, each measured in a
+# pristine address space so sizes never pollute each other's RSS).
+# Phase B boots a real server on a synthetic zone of that size and
+# drives the standard headline mix — steady-state qps as a function of
+# zone scale, same client, same mix.
+
+ZONE_SIZES = os.environ.get("BENCH_ZONE_SIZES",
+                            "100,10000,100000,1000000")
+N_ZONE = int(os.environ.get("BENCH_ZONE_QUERIES", "30000"))
+
+#: the dict-per-node representation this round replaced, measured at
+#: 100k names on this box immediately before the refactor (see
+#: docs/bench.md round-10 for provenance) — the comparator for the
+#: rss_per_name_vs_legacy ratio
+LEGACY_RSS_PER_NAME_BYTES = 2077.0
+
+
+def _proc_busy_fraction(pid: int, interval: float) -> float:
+    """CPU busy fraction of `pid` over `interval` seconds (utime+stime
+    from /proc)."""
+    def ticks() -> int:
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(") ", 1)[1].split()
+        return int(parts[11]) + int(parts[12])
+    try:
+        t0 = ticks()
+        time.sleep(interval)
+        t1 = ticks()
+    except (OSError, IndexError, ValueError):
+        return 0.0
+    hz = os.sysconf("SC_CLK_TCK")
+    return (t1 - t0) / hz / interval
+
+
+def _zone_scale_probe(n: int) -> Dict[str, object]:
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "zone_probe.py"),
+         str(n), "150", str(max(500, min(5000, n // 10)))],
+        capture_output=True, text=True, check=True,
+        timeout=600 + n // 2000)
+    return json.loads(out.stdout)
+
+
+def _zone_scale_qps(tmpdir: str, n: int) -> Dict[str, float]:
+    fixture = os.path.join(tmpdir, "fixture.json")
+    config = os.path.join(tmpdir, f"zone{n}.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    with open(config, "w") as f:
+        json.dump({
+            "dnsDomain": "bench.com", "datacenterName": "dc0",
+            "host": "127.0.0.1",
+            "store": {"backend": "fake", "fixture": fixture,
+                      "synthetic": {"hosts": n}},
+            "queryLog": False,
+        }, f)
+    proc = _launch_server(config)
+    try:
+        # mirror build is part of boot: scale the deadline with n
+        port, buf = _wait_for_line_buf(
+            proc, rb"UDP DNS service started on [\d.]+:(\d+)\"",
+            "bench server", timeout=30.0 + n / 10000.0)
+        m = re.search(rb"metrics server started on port (\d+)\"", buf)
+        mport = int(m.group(1)) if m else None
+        # steady state, not warm-up: at zone scale the precompile seed
+        # and zone fill stream in the background after serving starts
+        # (by design); wait for the seed to land AND the server to go
+        # CPU-idle (the zone fill has no scrapeable progress counter —
+        # idleness covers every background walk at once)
+        if mport is not None:
+            deadline = time.time() + 60.0 + n / 4000.0
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}/status",
+                            timeout=5) as resp:
+                        snap = json.loads(resp.read())
+                    pc = snap.get("precompile")
+                    if pc is None or pc.get("seed_remaining", 0) == 0:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.25)
+            while time.time() < deadline:
+                if _proc_busy_fraction(proc.pid, 0.5) < 0.25:
+                    break
+        res = _median_passes(
+            lambda: _drive_native(port, tmpdir, n=N_ZONE), 3)
+    finally:
+        _reap(proc)
+    return res
+
+
+def _bench_zone_scale(tmpdir: str) -> Dict[str, object]:
+    sizes = [int(s) for s in ZONE_SIZES.split(",") if s.strip()]
+    per_size: Dict[str, dict] = {}
+    control_qps = None
+    for n in sizes:
+        entry: Dict[str, object] = {}
+        probe = _zone_scale_probe(n)
+        entry["probe"] = probe
+        qps = _zone_scale_qps(tmpdir, n)
+        entry["qps"] = round(qps["qps"], 1)
+        entry["qps_spread"] = qps.get("qps_spread")
+        entry["p50_us"] = round(qps["p50_us"], 1)
+        entry["p99_us"] = round(qps["p99_us"], 1)
+        if control_qps is None:
+            control_qps = qps["qps"]
+        entry["qps_vs_control"] = round(qps["qps"] / control_qps, 3)
+        per_size[str(n)] = entry
+    largest = per_size[str(sizes[-1])]
+    smallest_probe = per_size[str(sizes[1])]["probe"] \
+        if len(sizes) > 1 else largest["probe"]
+    rss = largest["probe"]["mirror_rss_per_name_bytes"]
+    return {
+        "sizes": sizes,
+        "per_size": per_size,
+        # the acceptance headlines, precomputed so the JSON answers
+        # them without arithmetic
+        "rss_per_name_bytes": rss,
+        "legacy_rss_per_name_bytes": LEGACY_RSS_PER_NAME_BYTES,
+        "rss_per_name_vs_legacy": round(
+            LEGACY_RSS_PER_NAME_BYTES / rss, 2) if rss else None,
+        "mutation_p50_us_largest":
+            largest["probe"]["mutation_p50_us"],
+        "mutation_flatness": round(
+            largest["probe"]["mutation_p50_us"]
+            / smallest_probe["mutation_p50_us"], 2),
+        "qps_largest_vs_control": largest["qps_vs_control"],
+        "rebuild_max_loop_lag_ms_largest":
+            largest["probe"]["rebuild_max_loop_lag_ms"],
+        "parity_failures": sum(
+            e["probe"]["parity_failures"] for e in per_size.values()),
+    }
+
+
 def _try_axis(name: str, fn, retries: int = 1):
     """Run one bench axis, retrying once on failure: every axis is
     exception-guarded so a transient (a busy box stretching a startup
@@ -1843,7 +2002,7 @@ def _try_axis(name: str, fn, retries: int = 1):
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
-    realistic = degraded = shard = None
+    realistic = degraded = shard = zone_scale = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -1868,6 +2027,8 @@ def run_bench() -> Dict[str, object]:
             degraded = _try_axis("degraded",
                                  lambda: _bench_degraded(tmpdir))
             shard = _try_axis("shard", lambda: _bench_shard(tmpdir))
+            zone_scale = _try_axis("zone_scale",
+                                   lambda: _bench_zone_scale(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -2080,6 +2241,16 @@ def run_bench() -> Dict[str, object]:
         # distinct processes on M cores" claim is checkable in the JSON
         env["shard_pids"] = shard["pids"]
         env["shard_cores"] = shard["cores"]
+    if zone_scale is not None:
+        # zone_scale axis (ISSUE 7): mirror build/RSS/mutation-latency
+        # probes per size plus steady-state headline-mix qps at
+        # 10k/100k/1M names with the 100-name figure as control.  The
+        # summary keys answer the acceptance criteria directly:
+        # RSS/name vs the replaced dict-per-node representation,
+        # mutation latency flat from small to 1M (O(delta)), qps at the
+        # largest size within noise of the control, and the chunked
+        # session rebuild's worst observed loop stall.
+        out["zone_scale"] = zone_scale
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm,
         # with the balancer's own per-stage attribution riding along

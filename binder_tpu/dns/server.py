@@ -24,6 +24,7 @@ import ipaddress
 import logging
 import socket
 import struct
+import time
 from typing import Callable, List, Optional, Tuple
 
 from binder_tpu.dns.query import QueryCtx
@@ -143,6 +144,15 @@ class DnsServer:
         self.tcp_cap_refusals = 0
         self._cap_log_last = 0.0
         self._cap_log_pending = 0
+        # late (async-completed) UDP responses dropped at a full socket
+        # buffer: counted + flight-recorded so drops are VISIBLE at
+        # scale instead of a debug line nobody has enabled
+        # (binder_udp_late_drops_total; counter child installed by
+        # BinderServer, flight events rate-limited to one per window)
+        self.udp_late_drops = 0
+        self.late_drop_counter = None   # metrics child or None
+        self._late_drop_event_last = 0.0
+        self.LATE_DROP_EVENT_WINDOW_S = 1.0
         self.on_query: Optional[Callable] = None   # async (QueryCtx) -> None
         self.on_after: Optional[Callable] = None   # sync  (QueryCtx) -> None
         self._udp_socks: List[tuple] = []   # (loop, socket)
@@ -519,6 +529,27 @@ class DnsServer:
                 del self._udp_socks[i]
                 return
 
+    def note_late_drops(self, n: int) -> None:
+        """Account late (async-completed) UDP responses dropped at a
+        full send buffer: monotonic counter + metrics child
+        (binder_udp_late_drops_total) + a rate-limited flight event —
+        at production scale a silent drop path is an invisible SLO
+        leak, so the evidence must be scrapeable (ISSUE 7 satellite)."""
+        if n <= 0:
+            return
+        self.udp_late_drops += n
+        if self.late_drop_counter is not None:
+            self.late_drop_counter.inc(n)
+        self.log.debug("dropped %d late UDP responses "
+                       "(send buffer full)", n)
+        if self.recorder is not None:
+            now = time.monotonic()
+            if (now - self._late_drop_event_last
+                    >= self.LATE_DROP_EVENT_WINDOW_S):
+                self._late_drop_event_last = now
+                self.recorder.record("udp-late-drop", dropped=n,
+                                     total=self.udp_late_drops)
+
     def _batched_udp_reader(self, sock: socket.socket) -> Callable[[], None]:
         """recvmmsg/sendmmsg datapath (native/fastio/fastio.c).
 
@@ -552,10 +583,10 @@ class DnsServer:
                 if sent < len(out):
                     sent += send_batch(fd, out[sent:])
                     if sent < len(out):
-                        log.debug("dropped %d late UDP responses "
-                                  "(send buffer full)", len(out) - sent)
+                        self.note_late_drops(len(out) - sent)
             except OSError as e:
                 log.error("batched late UDP send failed: %s", e)
+                self.note_late_drops(len(out))
 
         def send_late(wire: bytes, addr) -> None:
             if not late_out:

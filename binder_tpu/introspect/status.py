@@ -178,22 +178,36 @@ class Introspector:
         zc = self.zk_cache
         if zc is None:
             return {"ready": False, "domain": None, "generation": 0,
-                    "epoch": 0, "nodes": 0, "reverse_entries": 0,
+                    "epoch": 0, "nodes": 0, "names": 0,
+                    "reverse_entries": 0, "interned_names": 0,
                     "staleness_seconds": None,
-                    "last_rebuild_age_seconds": None}
+                    "last_rebuild_age_seconds": None,
+                    "rebuild": {"pending": 0, "chunks": 0,
+                                "last_duration_seconds": None}}
         now = time.monotonic()
         rebuild = getattr(zc, "last_rebuild_mono", None)
         staleness = getattr(zc, "staleness_seconds", lambda: None)()
+        pool = getattr(zc, "pool", None)
         return {
             "ready": zc.is_ready(),
             "domain": zc.domain,
             "generation": zc.gen,
             "epoch": zc.epoch,
+            # zone scale (ISSUE 7): every bench/status reading carries
+            # the size it was measured at ("nodes" kept as the
+            # historical alias of the name count)
             "nodes": len(zc.nodes),
+            "names": len(zc.nodes),
             "reverse_entries": len(zc.rev_lookup),
+            "interned_names": len(pool) if pool is not None else 0,
             "staleness_seconds": staleness,
             "last_rebuild_age_seconds": (
                 None if rebuild is None else now - rebuild),
+            # chunked session-rebuild state (pending>0 == a re-mirror
+            # is streaming underneath live serving right now)
+            "rebuild": getattr(zc, "rebuild_info", lambda: {
+                "pending": 0, "chunks": 0,
+                "last_duration_seconds": None})(),
         }
 
     def _cache_section(self) -> dict:

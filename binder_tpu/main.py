@@ -49,6 +49,18 @@ def make_store(options: Dict[str, object], log: logging.Logger,
             with open(fixture) as f:
                 for path, obj in json.load(f).items():
                     store.put_json(path, obj)
+        synthetic = store_cfg.get("synthetic")
+        if synthetic:
+            # zone_scale bench / smoke surface: generate a
+            # production-scale zone procedurally instead of shipping a
+            # hundred-MB fixture file through JSON twice
+            from binder_tpu.store.fake import populate_synthetic
+            n = populate_synthetic(
+                store, str(options["dnsDomain"]),
+                hosts=int(synthetic.get("hosts", 0)),
+                racks=int(synthetic.get("racks", 0)),
+                subtree=str(synthetic.get("subtree", "zs")))
+            log.info("synthetic zone: %d host(s) generated", n)
         store.start_session()
         return store
     if backend == "zookeeper":
@@ -282,6 +294,19 @@ async def run(options: Dict[str, object]) -> BinderServer:
         announce=shard_worker is None,
     )
     await server.start()
+
+    if len(cache.nodes) > 100_000:
+        # large zones: the mirror is millions of long-lived objects; a
+        # gen-2 GC pass over them is a multi-hundred-ms serving stall
+        # for zero reclaim.  Freeze the resident set out of collection
+        # (query/mutation garbage still collects normally).  Runs
+        # BEFORE the loop-lag watchdog arms — the collect+freeze pass
+        # is itself a one-time stall-sized pause.
+        import gc
+        gc.collect()
+        gc.freeze()
+        log.info("large zone: froze %d mirrored names out of gc",
+                 len(cache.nodes))
 
     # fault injection (chaos) — ONLY when configured, for soaks and the
     # bench's degraded axis: a scripted FaultPlan drives session loss /

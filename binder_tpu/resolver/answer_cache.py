@@ -46,6 +46,8 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from binder_tpu.store import names as _names
+
 #: sentinel marking compiled-table keys inside the shared tag index
 _COMPILED = object()
 
@@ -54,11 +56,18 @@ class AnswerCache:
     __slots__ = ("size", "compiled_size", "expiry_s", "variants_cap",
                  "_entries", "_compiled", "_by_tag", "hits", "misses",
                  "invalidations", "neg_hits", "compiled_serves",
-                 "compiled_installs")
+                 "compiled_installs", "_intern")
 
     def __init__(self, size: int = 10000, expiry_ms: int = 60000,
                  variants_cap: int = 8,
-                 compiled_size: Optional[int] = None) -> None:
+                 compiled_size: Optional[int] = None,
+                 intern=None) -> None:
+        # canonicalizer for tag/qname strings entering the long-lived
+        # indexes: query-decoded names dedup against the mirror's own
+        # domain objects (MirrorCache.canon) or the process-wide pool,
+        # so a name is ONE object no matter how many layers index it
+        self._intern = intern if intern is not None \
+            else _names.intern_name
         self.size = size
         #: compiled-table occupancy bound; defaults to the per-key size
         #: (entries derive 1:1-ish from mirrored names, so operators with
@@ -144,6 +153,10 @@ class AnswerCache:
             # evict oldest insertion (dicts preserve insertion order)
             old_key = next(iter(self._entries))
             self._drop(old_key, self._entries[old_key])
+        if tag is not None:
+            tag = self._intern(tag)
+        if qkey is not None:
+            qkey = (qkey[0], self._intern(qkey[1]))
         self._entries[key] = [epoch, time.monotonic(), 0, [value],
                               not rotatable, tag, False, negative, qkey]
         self._by_tag.setdefault(tag, set()).add(key)
@@ -184,6 +197,9 @@ class AnswerCache:
         instead of forever."""
         if self.compiled_size <= 0 or not variants:
             return
+        qname = self._intern(qname)
+        if tag is not None:
+            tag = self._intern(tag)
         ckey = (qtype, qname)
         old = self._compiled.get(ckey)
         if old is not None:

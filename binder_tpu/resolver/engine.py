@@ -44,6 +44,7 @@ from binder_tpu.dns.wire import (
     Type,
 )
 from binder_tpu.store.cache import MirrorCache
+from binder_tpu.store.names import rec_parts as _rec_parts
 
 SRV_RE = re.compile(r"^(_[^_.]*)\.(_[^_.]*)\.(.*)$")
 NAME_RE = re.compile(r"[^a-z0-9_.-]")
@@ -52,6 +53,14 @@ NAME_RE = re.compile(r"[^a-z0-9_.-]")
 # (lib/server.js:352-360 — note: plain 'host' and 'db_host' are excluded).
 SERVICE_CHILD_TYPES = frozenset({
     "load_balancer", "moray_host", "ops_host", "rr_host", "redis_host",
+})
+
+# Record types the engine answers with a single A from the record's own
+# address (lib/server.js:306-320) — also exactly the types the compact
+# tuple representation fast-paths.
+HOST_LIKE_TYPES = frozenset({
+    "db_host", "host", "load_balancer", "moray_host", "redis_host",
+    "ops_host", "rr_host",
 })
 
 DEFAULT_TTL = 30  # reference lib/server.js:270 (the ZK session timeout)
@@ -257,6 +266,26 @@ class Resolver:
             p.rcode = Rcode.REFUSED
             return p
 
+        rec = node.rec
+        if type(rec) is tuple and rec[0] in HOST_LIKE_TYPES:
+            # compact host-like record (store/names.py): the dominant
+            # zone shape, resolved without materializing its dict form.
+            # Exactly the single-A / SRV-on-non-service outcomes of the
+            # generic branch below, same TTL precedence.
+            rtype, addr, rttl, rsttl = _rec_parts(rec)
+            ttl = rsttl if rsttl is not None else (
+                rttl if rttl is not None else DEFAULT_TTL)
+            if service is not None:
+                # SRV on a non-service name we own: NODATA + SOA for
+                # negative caching (lib/server.js:276-292)
+                p.authorities.append(SOARecord(
+                    name=domain, ttl=ttl, mname=self.dns_domain,
+                    minimum=ttl))
+                return self._apply_stale(p, stale)
+            p.groups.append(([ARecord(name=domain, ttl=ttl,
+                                      address=addr)], []))
+            return self._apply_stale(p, stale)
+
         record = node.data
         if not _valid_record(record):
             self.log.error("invalid store record at %s: %r", domain, record)
@@ -278,8 +307,7 @@ class Resolver:
             addr = urlparse(sub.get("primary", "")).hostname
             p.groups.append(([ARecord(name=domain, ttl=ttl, address=addr)],
                              []))
-        elif rtype in ("db_host", "host", "load_balancer", "moray_host",
-                       "redis_host", "ops_host", "rr_host"):
+        elif rtype in HOST_LIKE_TYPES:
             p.groups.append(([ARecord(name=domain, ttl=ttl,
                                       address=sub.get("address"))], []))
         elif rtype == "service":
@@ -351,25 +379,39 @@ class Resolver:
         # (lib/server.js:347-351)
         p.rcode = Rcode.NOERROR
 
-        kids = [k for k in node.children
-                if isinstance(k.data, dict)
-                and k.data.get("type") in SERVICE_CHILD_TYPES]
+        kids = []
+        for k in node.children:
+            kr = k.rec
+            if type(kr) is tuple:
+                if kr[0] in SERVICE_CHILD_TYPES:
+                    kids.append(k)
+            elif isinstance(kr, dict) \
+                    and kr.get("type") in SERVICE_CHILD_TYPES:
+                kids.append(k)
 
         for knode in kids:
-            krec = knode.data
-            if not _valid_record(krec):
-                p.rcode = Rcode.SERVFAIL
-                p.groups = []
-                self.log.error("bad store info under %s", domain)
-                return
-            ksub = krec[krec["type"]]
-            addr = ksub.get("address")
-            if addr is None:
-                continue
-            ports = ksub.get("ports")
-            if not ports:
+            kr = knode.rec
+            if type(kr) is tuple:
+                # compact member: address always present, no ports key
+                _kt, addr, kttl, ksttl = _rec_parts(kr)
                 ports = [s.get("port")]
-            rttl = _record_ttl(krec, ksub, ttl)
+                rttl = ksttl if ksttl is not None else (
+                    kttl if kttl is not None else ttl)
+            else:
+                krec = kr
+                if not _valid_record(krec):
+                    p.rcode = Rcode.SERVFAIL
+                    p.groups = []
+                    self.log.error("bad store info under %s", domain)
+                    return
+                ksub = krec[krec["type"]]
+                addr = ksub.get("address")
+                if addr is None:
+                    continue
+                ports = ksub.get("ports")
+                if not ports:
+                    ports = [s.get("port")]
+                rttl = _record_ttl(krec, ksub, ttl)
 
             if service is not None:
                 nm = f"{knode.name}.{domain}"
@@ -471,10 +513,16 @@ class Resolver:
             p.rcode = Rcode.REFUSED
             return p
 
-        record = node.data if isinstance(node.data, dict) else {}
-        rtype = record.get("type")
-        sub = record.get(rtype) if isinstance(rtype, str) else None
-        ttl = _record_ttl(record, sub if isinstance(sub, dict) else {})
+        rec = node.rec
+        if type(rec) is tuple:
+            _rt, _addr, rttl, rsttl = _rec_parts(rec)
+            ttl = rsttl if rsttl is not None else (
+                rttl if rttl is not None else DEFAULT_TTL)
+        else:
+            record = rec if isinstance(rec, dict) else {}
+            rtype = record.get("type")
+            sub = record.get(rtype) if isinstance(rtype, str) else None
+            ttl = _record_ttl(record, sub if isinstance(sub, dict) else {})
         p.groups.append(([PTRRecord(name=qname, ttl=ttl,
                                     target=node.domain)], []))
         return self._apply_stale(p, mode == "stale-serving")

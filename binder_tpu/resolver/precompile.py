@@ -72,16 +72,39 @@ Item = Tuple[int, str]   # (qtype, qname)
 
 
 class Precompiler:
-    #: items compiled per event-loop pass — bounds the refill work a
-    #: mutation burst can inject between serving batches
+    #: items compiled per event-loop pass — the FLOOR; the drain keeps
+    #: going past it only while the time budget below lasts, so backlog
+    #: drain rate scales with how cheap the renders actually are
+    #: instead of a fixed count guessing at it
     BATCH = 64
-    #: queue bound; enqueues past it are shed (lazy fallback)
+    #: hard per-pass ceiling (a pass of pathologically cheap items must
+    #: still yield the loop)
+    MAX_BATCH = 512
+    #: per-pass wall budget: refill work between serving batches stays
+    #: well under the loop-lag watchdog threshold even at zone scale
+    DRAIN_BUDGET_S = 0.002
+    #: queue bound FLOOR; the effective bound scales with the mirrored
+    #: zone (``_max_pending``) so a large zone's legitimate churn burst
+    #: is not shed at a toy zone's threshold, while staying hard-capped
     MAX_PENDING = 2048
+    MAX_PENDING_CAP = 65536
     #: rotation variants rendered per rotatable answer set, in lockstep
     #: with AnswerCache.variants_cap / the native FP_MAX_VARIANTS
     VARIANTS_CAP = 8
+    #: answer-set size ceiling: a service with hundreds of members
+    #: renders VARIANTS_CAP full rotations of the whole set — one such
+    #: item can cost hundreds of ms (a measured 300 ms loop stall at
+    #: zone scale), and its wire exceeds every UDP payload so the
+    #: compiled entry could never serve UDP anyway.  Oversize sets stay
+    #: lazy (the engine serves them, with TC -> TCP as usual).
+    MAX_SET_RECORDS = 64
     #: shed flight-recorder events are rate-limited to one per window
     SHED_EVENT_WINDOW_S = 1.0
+    #: zones at or below this seed inline at startup (the historical
+    #: behavior every small-zone test relies on); larger mirrors seed
+    #: from a chunked background task so a million-name zone starts
+    #: serving immediately and fills in behind the traffic
+    SEED_INLINE_MAX = 20000
 
     def __init__(self, *, resolver, answer_cache, zk_cache, summarize,
                  collector=None, recorder=None,
@@ -102,6 +125,9 @@ class Precompiler:
         # insertion-ordered set of pending items (dict keys)
         self._pending: dict = {}
         self._drain_scheduled = False
+        # chunked startup seed (large zones only)
+        self._seed_task = None
+        self._seed_remaining = 0
         # monotonic counters (also folded into the metrics below)
         self.compiled = 0
         self.declined = 0
@@ -192,7 +218,7 @@ class Precompiler:
         question identity — a name mutated ten times in one burst is
         rendered once, under its freshest evidence."""
         pending = self._pending
-        room = self.MAX_PENDING - len(pending)
+        room = self._max_pending() - len(pending)
         shed = 0
         for qtype, qname, evidence_at in items:
             key = (qtype, qname)
@@ -220,7 +246,15 @@ class Precompiler:
             self._shed_event_last = now
             self.recorder.record(
                 "precompile-shed", shed=shed, pending=len(self._pending),
-                max_pending=self.MAX_PENDING)
+                max_pending=self._max_pending())
+
+    def _max_pending(self) -> int:
+        """Scale-aware queue bound: at least MAX_PENDING, growing with
+        the mirrored zone up to the hard cap.  A 100-name test zone
+        sheds exactly where it always did; a million-name zone's watch
+        storm gets a proportionate buffer before degrading to lazy."""
+        return max(self.MAX_PENDING,
+                   min(len(self.zk_cache.nodes), self.MAX_PENDING_CAP))
 
     # -- the bounded drain --
 
@@ -246,7 +280,8 @@ class Precompiler:
     def _drain(self) -> None:
         self._drain_scheduled = False
         n = 0
-        while self._pending and n < self.BATCH:
+        t0 = time.perf_counter()
+        while self._pending and n < self.MAX_BATCH:
             item, ev = self._pop()
             try:
                 self._compile_one(item, evidence_at=ev)
@@ -256,33 +291,72 @@ class Precompiler:
                 self.log.exception("precompile failed for %s", item)
                 self._decline()
             n += 1
+            if (n >= self.BATCH
+                    and time.perf_counter() - t0 >= self.DRAIN_BUDGET_S):
+                break
         if self._pending:
             # more pending: yield to I/O first (call_soon callbacks
             # added during a loop pass run on the NEXT pass)
             self._schedule()
 
     def seed_mirror(self) -> None:
-        """Compile every currently mirrored name inline — run once at
-        server start, for mirrors built before this server subscribed
-        to invalidation events (the same reason ``_zone_fill`` exists).
-        Later arrivals ride the mutation path."""
-        for domain, node in list(self.zk_cache.nodes.items()):
-            for item in self.items_for_tag(domain):
+        """Compile every currently mirrored name — run once at server
+        start, for mirrors built before this server subscribed to
+        invalidation events (the same reason ``_zone_fill`` exists).
+        Later arrivals ride the mutation path.
+
+        Small zones seed inline (the historical semantics: precompiled
+        from query one).  Past ``SEED_INLINE_MAX`` the walk moves to a
+        time-budgeted background task — a million-name zone must start
+        SERVING immediately; unseeded names resolve lazily until their
+        chunk lands (scale-aware backpressure, ISSUE 7)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is None \
+                or len(self.zk_cache.nodes) <= self.SEED_INLINE_MAX:
+            for domain in list(self.zk_cache.nodes):
+                self._seed_one(domain)
+            return
+        self._seed_task = loop.create_task(self._seed_chunked())
+
+    def _seed_one(self, domain: str) -> None:
+        node = self.zk_cache.nodes.get(domain)
+        if node is None:
+            return                      # left the mirror mid-walk
+        for item in self.items_for_tag(domain):
+            try:
+                self._compile_one(item, native=True)
+            except Exception:
+                self.log.exception("precompile seed failed for %s", item)
+        ip = getattr(node, "ip", None)
+        if ip and type(ip) is str:
+            parts = ip.split(".")
+            if len(parts) == 4 and all(p.isdigit() for p in parts):
+                rev = ".".join(reversed(parts)) + ".in-addr.arpa"
                 try:
-                    self._compile_one(item, native=True)
+                    self._compile_one((Type.PTR, rev), native=True)
                 except Exception:
-                    self.log.exception("precompile seed failed for %s",
-                                       item)
-            ip = getattr(node, "ip", None)
-            if ip:
-                parts = ip.split(".")
-                if len(parts) == 4 and all(p.isdigit() for p in parts):
-                    rev = ".".join(reversed(parts)) + ".in-addr.arpa"
-                    try:
-                        self._compile_one((Type.PTR, rev), native=True)
-                    except Exception:
-                        self.log.exception(
-                            "precompile seed failed for %s", rev)
+                    self.log.exception(
+                        "precompile seed failed for %s", rev)
+
+    async def _seed_chunked(self) -> None:
+        domains = list(self.zk_cache.nodes)
+        self._seed_remaining = len(domains)
+        self.log.info("precompile seed: %d names, chunked", len(domains))
+        started = time.perf_counter()
+        i = 0
+        while i < len(domains):
+            t0 = time.perf_counter()
+            while i < len(domains) \
+                    and time.perf_counter() - t0 < self.DRAIN_BUDGET_S:
+                self._seed_one(domains[i])
+                i += 1
+            self._seed_remaining = len(domains) - i
+            await asyncio.sleep(0)
+        self.log.info("precompile seed done: %d names in %.1fs",
+                      len(domains), time.perf_counter() - started)
 
     # -- one item: plan → render variants → install --
 
@@ -323,6 +397,10 @@ class Precompiler:
             self._decline()
             return
         groups = plan.groups
+        if sum(len(g[0]) + len(g[1]) for g in groups) \
+                > self.MAX_SET_RECORDS:
+            self._decline()             # oversize answer set: lazy
+            return
         nv = min(len(groups), self.VARIANTS_CAP) if plan.rotatable else 1
         variants = []
         summarize = self.summarize
@@ -386,9 +464,10 @@ class Precompiler:
     def introspect(self) -> dict:
         return {
             "queue_depth": len(self._pending),
-            "max_pending": self.MAX_PENDING,
+            "max_pending": self._max_pending(),
             "batch": self.BATCH,
             "compiled": self.compiled,
             "declined": self.declined,
             "shed": self.shed,
+            "seed_remaining": self._seed_remaining,
         }

@@ -48,6 +48,7 @@ from binder_tpu.metrics.collector import (
 )
 from binder_tpu.resolver.answer_cache import AnswerCache
 from binder_tpu.resolver.precompile import Precompiler
+from binder_tpu.store.names import rec_parts as _names_rec_parts
 from binder_tpu.resolver.engine import (
     DEFAULT_TTL,
     Resolver,
@@ -105,6 +106,19 @@ _LANE_HOST_TYPES = frozenset({
     "db_host", "host", "load_balancer", "moray_host", "redis_host",
     "ops_host", "rr_host",
 })
+
+
+def _rec_ttl(rec: tuple) -> int:
+    """Deepest-object-wins TTL for a COMPACT record tuple
+    (store/names.py) — sub-record TTL wins, else record TTL, else
+    default; the compact invariant guarantees ints, so there is no
+    garbage case to decline on."""
+    parts = _names_rec_parts(rec)
+    if parts[3] is not None:
+        return parts[3]
+    if parts[2] is not None:
+        return parts[2]
+    return DEFAULT_TTL
 
 
 def _lane_ttl(record: dict, sub) -> Optional[int]:
@@ -184,9 +198,12 @@ class BinderServer:
         self.query_log = query_log
         # encoded-answer cache (the reference's -s/-a flags, main.js:34-38)
         self.zk_cache = zk_cache
-        self.answer_cache = AnswerCache(size=cache_size,
-                                        expiry_ms=cache_expiry_ms,
-                                        compiled_size=precompile_size)
+        self.answer_cache = AnswerCache(
+            size=cache_size, expiry_ms=cache_expiry_ms,
+            compiled_size=precompile_size,
+            # tag/qname strings dedup against the mirror's own domain
+            # objects (the interned-name pool architecture, ISSUE 7)
+            intern=getattr(zk_cache, "canon", None))
         self.cache_hit_counter = self.collector.counter(
             "binder_answer_cache_hits", "encoded-answer cache hits")
         self._cache_hit_child = self.cache_hit_counter.labelled()
@@ -301,6 +318,14 @@ class BinderServer:
             "TCP connections refused at the connection cap").labelled()
         self._cap_refusal_child.inc(0)   # series exists from scrape 1
         self._cap_folded = 0
+        # late (async-completed) UDP responses dropped at a full socket
+        # buffer — previously a silent debug line (ISSUE 7 satellite)
+        late_drops = self.collector.counter(
+            "binder_udp_late_drops_total",
+            "late (async-completed) UDP responses dropped because the "
+            "socket send buffer stayed full through the retry").labelled()
+        late_drops.inc(0)                # series exists from scrape 1
+        self.engine.late_drop_counter = late_drops
         # stream-lane counters (dns/stream.py TcpStats), folded at
         # scrape time like the cap refusals; every series exists from
         # scrape 1 so absence is always an exporter bug
@@ -433,6 +458,7 @@ class BinderServer:
                                       None)
         self._zone_dirty: set = set()
         self._zone_drain_pending = False
+        self._zone_fill_task = None
         self.zone_serve_counter = self.collector.counter(
             "binder_zone_serves",
             "queries answered from precompiled zone entries")
@@ -797,8 +823,8 @@ class BinderServer:
                 node = self.zk_cache.lookup(name)
                 if node is None:
                     pass
-                elif (type(node.data) is dict
-                        and node.data.get("type") == "service"):
+                elif (type(node.rec) is dict
+                        and node.rec.get("type") == "service"):
                     self._zone_push_service_a(name, node)
                     self._zone_push_service_srv(name, node)
                 else:
@@ -813,14 +839,23 @@ class BinderServer:
         record the raw lane would answer, else None — the eligibility
         rules are _raw_lane's, verbatim, so the zone table can never
         answer a shape the lane would decline."""
-        record = node.data
-        rt = record.get("type") if type(record) is dict else None
+        rec = node.rec
+        if type(rec) is tuple:
+            # compact host-like: the only decline left is the address
+            # canonicality check (TTLs are ints by invariant)
+            if rec[0] not in _LANE_HOST_TYPES:
+                return None
+            packed = BinderServer._zone_packed_addr(rec[1])
+            if packed is None:
+                return None
+            return rec, None, packed, _rec_ttl(rec)
+        rt = rec.get("type") if type(rec) is dict else None
         if rt not in _LANE_HOST_TYPES:
             return None
-        sub = record.get(rt)
+        sub = rec.get(rt)
         if type(sub) is not dict:
             return None
-        return BinderServer._zone_a_tail(record, sub, sub.get("address"))
+        return BinderServer._zone_a_tail(rec, sub, sub.get("address"))
 
     @staticmethod
     def _zone_packed_addr(addr):
@@ -876,9 +911,9 @@ class BinderServer:
         done once at mutation time instead of per query)."""
         if not self._zone_suffix_ok(name):
             return
-        record = node.data
-        if (type(record) is dict and record.get("type") == "database"):
-            shape = self._zone_database_shape(record)
+        rec = node.rec
+        if type(rec) is dict and rec.get("type") == "database":
+            shape = self._zone_database_shape(rec)
         else:
             shape = self._zone_host_shape(node)
         if shape is None:
@@ -948,11 +983,25 @@ class BinderServer:
         like engine._resolve_service does."""
         members = []
         for knode in node.children:
-            krec = knode.data
-            if not (type(krec) is dict
-                    and krec.get("type") in _SERVICE_CHILD_TYPES):
+            kr = knode.rec
+            if type(kr) is tuple:
+                # compact member (store/names.py): address present and
+                # TTLs int by invariant; no ports key — the SRV push
+                # falls back to the service-level default port
+                if kr[0] not in _SERVICE_CHILD_TYPES:
+                    continue
+                packed = self._zone_packed_addr(kr[1])
+                if packed is None:
+                    return None         # encode would fail: decline
+                parts = _names_rec_parts(kr)
+                rttl = parts[3] if parts[3] is not None else (
+                    parts[2] if parts[2] is not None else ttl)
+                members.append((knode, None, packed, rttl))
+                continue
+            if not (type(kr) is dict
+                    and kr.get("type") in _SERVICE_CHILD_TYPES):
                 continue                # engine filters these out too
-            ksub = krec.get(krec["type"])
+            ksub = kr.get(kr["type"])
             if type(ksub) is not dict:
                 return None             # engine SERVFAILs mid-set
             addr = ksub.get("address")
@@ -961,7 +1010,7 @@ class BinderServer:
             packed = self._zone_packed_addr(addr)
             if packed is None:
                 return None             # encode would fail: decline
-            rttl = _engine_record_ttl(krec, ksub, ttl)
+            rttl = _engine_record_ttl(kr, ksub, ttl)
             if type(rttl) is not int:
                 return None
             members.append((knode, ksub, packed, rttl))
@@ -985,6 +1034,8 @@ class BinderServer:
         members = self._zone_service_members(node, ttl)
         if not members:
             return                      # NODATA shape: Python answers
+        if len(members) > Precompiler.MAX_SET_RECORDS:
+            return      # oversize rotation set: lazy (see precompile.py)
         answers = [
             (b"\xc0\x0c\x00\x01\x00\x01"
              + struct.pack(">IH", min(ttl, rttl) & 0xFFFFFFFF, 4)
@@ -1049,9 +1100,13 @@ class BinderServer:
         raw_members = self._zone_service_members(node, ttl)
         if not raw_members:
             return                      # empty set: NOERROR via Python
+        if len(raw_members) > Precompiler.MAX_SET_RECORDS:
+            return      # oversize rotation set: lazy (see precompile.py)
         members = []
         for knode, ksub, packed, rttl in raw_members:
-            ports = ksub.get("ports")
+            # compact members (ksub None) carry no ports key by
+            # invariant: the service-level default port applies
+            ports = ksub.get("ports") if type(ksub) is dict else None
             if not ports:
                 ports = [default_port]
             if type(ports) is not list:
@@ -1166,21 +1221,68 @@ class BinderServer:
                                       self.zk_cache.epoch, ancount,
                                       bodies, tag, arcount)
 
+    #: per-pass wall budget for the chunked zone fill / seed walks
+    _FILL_BUDGET_S = 0.002
+
     def _zone_fill(self) -> None:
         """Walk the mirror and push every eligible precompiled answer —
         run at server start for mirrors built before this server
         subscribed to invalidation events (later arrivals ride
-        _on_store_invalidate)."""
+        _on_store_invalidate).  Small zones fill inline (the historical
+        semantics); at zone scale the walk moves to a time-budgeted
+        background task so serving starts immediately and the fill
+        streams in behind it (un-filled names resolve through the
+        raw lane / generic path — slower, never wrong)."""
         if not self._zone_enabled:
             return
-        for domain, node in list(self.zk_cache.nodes.items()):
-            self._zone_refresh(domain)
-            ip = getattr(node, "ip", None)
-            if ip:
-                parts = ip.split(".")
-                if len(parts) == 4 and all(p.isdigit() for p in parts):
-                    self._zone_refresh(
-                        ".".join(reversed(parts)) + ".in-addr.arpa")
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        nodes = self.zk_cache.nodes
+        reserve = getattr(_fastio, "fastpath_zone_reserve", None)
+        if reserve is not None and len(nodes) > 1024:
+            # presize the native zone table for the fill (one A + one
+            # PTR entry per host): growth rehashes are O(table) and the
+            # largest one at zone scale measured ~370 ms — an
+            # event-loop stall mid-serving, not a hiccup
+            try:
+                reserve(self._fastpath, 2 * len(nodes))
+            except (TypeError, ValueError, MemoryError) as e:
+                self.log.debug("zone-table reserve skipped: %s", e)
+        if loop is not None and len(nodes) > Precompiler.SEED_INLINE_MAX:
+            self._zone_fill_task = loop.create_task(
+                self._zone_fill_chunked())
+            return
+        for domain in list(nodes):
+            self._zone_fill_one(domain)
+
+    def _zone_fill_one(self, domain: str) -> None:
+        node = self.zk_cache.nodes.get(domain)
+        if node is None:
+            return                      # left the mirror mid-walk
+        self._zone_refresh(domain)
+        ip = node.ip
+        if ip and type(ip) is str:
+            parts = ip.split(".")
+            if len(parts) == 4 and all(p.isdigit() for p in parts):
+                self._zone_refresh(
+                    ".".join(reversed(parts)) + ".in-addr.arpa")
+
+    async def _zone_fill_chunked(self) -> None:
+        domains = list(self.zk_cache.nodes)
+        self.log.info("zone fill: %d names, chunked", len(domains))
+        started = time.perf_counter()
+        i = 0
+        while i < len(domains):
+            t0 = time.perf_counter()
+            while i < len(domains) \
+                    and time.perf_counter() - t0 < self._FILL_BUDGET_S:
+                self._zone_fill_one(domains[i])
+                i += 1
+            await asyncio.sleep(0)
+        self.log.info("zone fill done: %d names in %.1fs", len(domains),
+                      time.perf_counter() - started)
 
     def _fastpath_push(self, key, epoch: int, query: QueryCtx) -> None:
         """Promote an answer-cache entry to the native fast path (on
@@ -1467,25 +1569,33 @@ class BinderServer:
                         rcode = Rcode.REFUSED
 
             if rcode == 0 and node is not None:
-                record = node.data
-                rt = record.get("type") if type(record) is dict else None
-                if rt not in _LANE_HOST_TYPES:
-                    return False       # service/database/invalid record
-                sub = record.get(rt)
-                if type(sub) is not dict:
-                    return False
-                addr = sub.get("address")
-                if type(addr) is not str:
-                    return False
+                rec = node.rec
+                if type(rec) is tuple:
+                    # compact host-like (store/names.py): address and
+                    # int TTLs by invariant, canonicality still checked
+                    if rec[0] not in _LANE_HOST_TYPES:
+                        return False
+                    addr = rec[1]
+                    ttl = _rec_ttl(rec)
+                else:
+                    rt = rec.get("type") if type(rec) is dict else None
+                    if rt not in _LANE_HOST_TYPES:
+                        return False   # service/database/invalid record
+                    sub = rec.get(rt)
+                    if type(sub) is not dict:
+                        return False
+                    addr = sub.get("address")
+                    if type(addr) is not str:
+                        return False
+                    ttl = _lane_ttl(rec, sub)
+                    if ttl is None:
+                        return False   # store garbage: generic path
                 try:
                     packed = _socket.inet_aton(addr)
                 except (OSError, TypeError):
                     return False       # generic path SERVFAILs
                 if _socket.inet_ntoa(packed) != addr:
                     return False       # non-canonical dotted quad
-                ttl = _lane_ttl(record, sub)
-                if ttl is None:
-                    return False       # store garbage: generic path
                 body = (b"\xc0\x0c\x00\x01\x00\x01"
                         + struct.pack(">IH", ttl & 0xFFFFFFFF, 4)
                         + packed)
@@ -1516,12 +1626,16 @@ class BinderServer:
                         return False   # recursion handoff: generic path
                     rcode = Rcode.REFUSED
                 else:
-                    record = node.data if type(node.data) is dict else {}
-                    rt = record.get("type")
-                    sub = record.get(rt) if type(rt) is str else None
-                    ttl = _lane_ttl(record, sub)
-                    if ttl is None:
-                        return False   # store garbage: generic path
+                    rec = node.rec
+                    if type(rec) is tuple:
+                        ttl = _rec_ttl(rec)
+                    else:
+                        record = rec if type(rec) is dict else {}
+                        rt = record.get("type")
+                        sub = record.get(rt) if type(rt) is str else None
+                        ttl = _lane_ttl(record, sub)
+                        if ttl is None:
+                            return False   # store garbage: generic path
                     target = node.domain
                     if target.endswith(".arpa"):
                         # the generic encoder could compress the target

@@ -42,6 +42,7 @@ from typing import Callable, Optional
 from binder_tpu.shard import protocol
 from binder_tpu.store.cache import domain_to_path
 from binder_tpu.store.fake import FakeStore
+from binder_tpu.store.names import intern_name
 
 
 class ShardLinkDown(Exception):
@@ -81,19 +82,28 @@ class ReplicaStore(FakeStore):
     # -- attach-time snapshot (blocking; runs before the event loop) --
 
     def read_snapshot(self, timeout: float = 30.0) -> int:
-        """Apply frames until ``snap-end``; returns the node count."""
+        """Apply frames until ``snap-end``; returns the node count.
+
+        ``timeout`` bounds the time WITHOUT PROGRESS, not the total:
+        the supervisor streams large-zone snapshots in bounded chunks
+        at the link's pace, so a million-name snapshot legitimately
+        takes longer than any fixed total deadline — what signals a
+        wedged supervisor is the stream going quiet."""
         self._sock.setblocking(True)
         self._sock.settimeout(timeout)
         deadline = time.monotonic() + timeout
         while True:
-            for frame in self._recv_frames():
+            frames = self._recv_frames()
+            if frames:
+                deadline = time.monotonic() + timeout   # progress
+            for frame in frames:
                 if frame.get("op") == "snap-end":
                     self.snapshot_nodes = int(frame.get("nodes", 0))
                     self._sock.settimeout(None)
                     return self.snapshot_nodes
                 self._apply(frame)
             if time.monotonic() > deadline:
-                raise TimeoutError("shard snapshot not complete within "
+                raise TimeoutError("shard snapshot stalled for "
                                    f"{timeout}s")
 
     def _recv_frames(self):
@@ -162,7 +172,11 @@ class ReplicaStore(FakeStore):
     def _apply(self, frame: dict) -> None:
         op = frame.get("op")
         if op == "node":
-            self._apply_node(str(frame["d"]), frame.get("data"))
+            # intern the frame's domain: delta frames repeat the same
+            # hot names endlessly, and the pool makes each ONE object
+            # across the protocol, the replica tree, and the mirror
+            self._apply_node(intern_name(str(frame["d"])),
+                             frame.get("data"))
         elif op == "gone":
             self.rmr(domain_to_path(str(frame["d"])))
         elif op == "state":

@@ -41,6 +41,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from binder_tpu.introspect.status import Introspector
@@ -66,7 +67,8 @@ class ShardLink:
 
     __slots__ = ("shard", "proc", "sock", "wbuf", "writer_armed",
                  "hello", "stats", "stats_at", "last_requests",
-                 "spawned_mono", "rbuf", "closed")
+                 "spawned_mono", "rbuf", "closed",
+                 "snap_queue", "snap_sent", "snap_started")
 
     def __init__(self, shard: int, proc: subprocess.Popen,
                  sock: socket.socket) -> None:
@@ -84,6 +86,13 @@ class ShardLink:
         self.last_requests = 0.0
         self.spawned_mono = time.monotonic()
         self.closed = False
+        # chunked attach-time snapshot state: the walk queue of owner
+        # mirror nodes still to frame (None once snap-end was sent),
+        # frames sent so far, and the start instant for the stall
+        # backstop
+        self.snap_queue: Optional[object] = None
+        self.snap_sent = 0
+        self.snap_started = 0.0
 
 
 class ShardSupervisor:
@@ -284,16 +293,68 @@ class ShardSupervisor:
         state, connected, disc, est = self._state_tuple()
         return protocol.state_frame(state, connected, disc, est)
 
+    #: node frames per snapshot pump pass (one event-loop callback);
+    #: bounds the time the supervisor loop spends framing before it
+    #: yields back to heartbeats, stats folding, and the other links
+    SNAP_CHUNK = 2048
+    #: outbound high-water during a snapshot: the pump pauses above
+    #: this and resumes from the writability callback, so a large-zone
+    #: snapshot streams at the worker's pace instead of materializing
+    #: the whole mirror in the link buffer (the old eager build put a
+    #: million-name snapshot straight into wbuf — nearly the wedge-kill
+    #: cap — while blocking the loop for the entire walk)
+    SNAP_HIGH_WATER = 4 << 20
+    #: a snapshot making no progress for this long means a wedged
+    #: worker; kill for respawn (snapshot catch-up IS the recovery)
+    SNAP_STALL_S = 120.0
+
     def _send_snapshot(self, link: ShardLink) -> None:
-        frames = [self._state_frame()]
-        domains = protocol.snapshot_order(self.cache.nodes)
-        for d in domains:
-            node = self.cache.nodes.get(d)
-            if node is not None:
-                frames.append(protocol.node_frame(d, node.data))
-        frames.append(protocol.snap_end_frame(len(domains)))
-        for frame in frames:
-            self._send(link, frame)
+        """Start the CHUNKED attach-time snapshot: a state frame now,
+        then node frames streamed in bounded pump passes (tree order —
+        parents before children — via a breadth-first walk of the owner
+        mirror), then snap-end.  Deltas and state heartbeats produced
+        while the snapshot streams simply interleave into the same
+        ordered stream: node frames are upserts read from live mirror
+        state, so replaying them in any interleaving converges the
+        worker to the owner's view."""
+        self._send(link, self._state_frame())
+        link.snap_queue = deque()
+        link.snap_sent = 0
+        link.snap_started = time.monotonic()
+        root = self.cache.nodes.get(self.cache.domain)
+        if root is not None:
+            link.snap_queue.append(root)
+        self._pump_snapshot(link)
+
+    def _pump_snapshot(self, link: ShardLink) -> None:
+        q = link.snap_queue
+        if link.closed or q is None:
+            return
+        nodes = self.cache.nodes
+        n = 0
+        while q and n < self.SNAP_CHUNK \
+                and len(link.wbuf) < self.SNAP_HIGH_WATER:
+            node = q.popleft()
+            if nodes.get(node.domain) is not node:
+                continue                # subtree left the mirror mid-walk
+            for kid in node.children:
+                q.append(kid)
+            link.wbuf.extend(protocol.encode_frame(
+                protocol.node_frame(node.domain, node.data)))
+            link.snap_sent += 1
+            n += 1
+        if n:
+            link.snap_started = time.monotonic()   # progress
+        self._flush(link)
+        if link.closed or link.snap_queue is None:
+            return                      # flush may have severed the link
+        if q:
+            if len(link.wbuf) >= self.SNAP_HIGH_WATER:
+                return      # paused: _on_worker_writable resumes the pump
+            self._loop.call_soon(self._pump_snapshot, link)
+            return
+        link.snap_queue = None
+        self._send(link, protocol.snap_end_frame(link.snap_sent))
 
     def _on_invalidate(self, tags) -> None:
         """Owner-mirror invalidation -> delta frames.  Tags are lookup
@@ -356,6 +417,11 @@ class ShardSupervisor:
             pass
         link.writer_armed = False
         self._flush(link)
+        # a paused snapshot resumes once the worker drained us below
+        # the high-water mark
+        if (link.snap_queue is not None and not link.closed
+                and len(link.wbuf) < self.SNAP_HIGH_WATER):
+            self._pump_snapshot(link)
 
     # -- worker -> supervisor frames --
 
@@ -423,6 +489,7 @@ class ShardSupervisor:
         if link.closed:
             return
         link.closed = True
+        link.snap_queue = None
         try:
             self._loop.remove_reader(link.sock.fileno())
         except (OSError, ValueError):
@@ -458,6 +525,16 @@ class ShardSupervisor:
         if self._draining:
             return
         now = time.monotonic()
+        # snapshot stall backstop: a worker that stopped draining its
+        # attach snapshot is wedged — kill it and let respawn + a fresh
+        # snapshot do its job
+        for link in list(self.links.values()):
+            if (link.snap_queue is not None and not link.closed
+                    and now - link.snap_started > self.SNAP_STALL_S):
+                self.log.error("shard %d: snapshot stalled %.0fs; "
+                               "killing for respawn", link.shard,
+                               now - link.snap_started)
+                self.kill_shard(link.shard)
         for i in range(self.n):
             link = self.links.get(i)
             if link is not None and link.proc.poll() is None:

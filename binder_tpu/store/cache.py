@@ -24,23 +24,34 @@ flags in §7.3; both strictly reduce wrong answers):
 - ``unbind`` also removes the node's reverse entry; the reference leaks it,
   so PTR queries could resolve to hosts that left the tree
   (``lib/zk.js:195-208`` never touches ca_revLookup).
+
+Production-zone-scale representation (ISSUE 7): nodes store COMPACT
+records (``store/names.py`` — host-likes as 4-tuples, everything else
+with interned keys) and interned domain strings; ``data`` is a property
+that expands on demand so every consumer keeps reading parsed-JSON
+shapes, while hot paths read ``TreeNode.rec`` directly.  The
+session-event full rebuild is CHUNKED across event-loop passes
+(time-budgeted) so a million-name re-mirror never stalls serving or
+trips the loop-lag watchdog — the mirror keeps answering from its
+existing nodes while the walk re-registers watchers underneath it.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
-from binder_tpu.store.interface import StoreClient, Watcher
+from binder_tpu.store import names as _names
+from binder_tpu.store.interface import StoreClient
 
 # Record types that represent a single addressable host: these maintain the
-# reverse (PTR) map and are the types a service's children may carry.
-# Reference ``lib/zk.js:172-179``.
-HOST_TYPES = frozenset({
-    "db_host", "host", "load_balancer", "moray_host",
-    "redis_host", "ops_host", "rr_host",
-})
+# reverse (PTR) map and are the types a service's children may carry
+# (reference ``lib/zk.js:172-179``) — and exactly the types the compact
+# tuple representation covers (the canonical set lives in store/names.py).
+HOST_TYPES = _names.HOST_TYPES
 
 
 def domain_to_path(domain: str) -> str:
@@ -65,66 +76,136 @@ def _rev_name(ip: Optional[str]) -> Optional[str]:
 
 
 class TreeNode:
-    """One mirrored znode == one domain label (reference TreeNode)."""
+    """One mirrored znode == one domain label (reference TreeNode).
 
-    __slots__ = ("name", "domain", "path", "cache", "kids", "data", "ip",
-                 "watcher", "log")
+    Memory layout is the point at zone scale: six slots, the domain
+    interned, ``kids`` allocated only for interior nodes (None for the
+    million leaves), the record compact (``names.compact_record``), and
+    ``name``/``path``/``data`` derived on demand instead of stored."""
+
+    __slots__ = ("domain", "cache", "kids", "_rec")
 
     def __init__(self, cache: "MirrorCache", parent_domain: str,
                  name: str) -> None:
-        self.name = name
         domain = name if not parent_domain else name + "." + parent_domain
+        # NOT pool-interned: each mirrored domain is unique, so the
+        # nodes index itself is its canonical home (MirrorCache.canon);
+        # pooling a million one-off strings would cost a pool entry per
+        # name for zero dedup
         self.domain = domain.lower()
-        self.path = domain_to_path(self.domain)
         self.cache = cache
-        self.kids: Dict[str, TreeNode] = {}
-        self.data = None
-        self.ip: Optional[str] = None
-        self.watcher: Optional[Watcher] = None
-        self.log = cache.log
+        # labels of current children (a tuple, not a dict of nodes:
+        # children resolve through the cache's node index on demand);
+        # None for the leaf-heavy common case
+        self.kids: Optional[tuple] = None
+        self._rec = None
         cache.nodes[self.domain] = self
 
     @property
+    def name(self) -> str:
+        return self.domain.split(".", 1)[0]
+
+    @property
+    def path(self) -> str:
+        return domain_to_path(self.domain)
+
+    @property
+    def log(self) -> logging.Logger:
+        return self.cache.log
+
+    @property
+    def rec(self):
+        """The stored record in its COMPACT form: a
+        ``names.CompactRec`` tuple for host-like single-address
+        records, else the parsed JSON shape.  The hot paths' accessor —
+        no per-read allocation."""
+        return self._rec
+
+    @property
+    def data(self):
+        """The record as parsed JSON (dict/list/None) — expanded on
+        demand from the compact form.  Equal (``==``) to what
+        ``json.loads`` produced; identity is not preserved."""
+        return _names.expand_record(self._rec)
+
+    @property
+    def ip(self) -> Optional[str]:
+        """The address this node's record binds in the reverse map —
+        derived from the record (was a stored slot; at a million
+        names every slot counts)."""
+        rec = self._rec
+        if type(rec) is tuple:
+            return rec[1] if rec[0] in HOST_TYPES else None
+        if isinstance(rec, dict):
+            rtype = rec.get("type")
+            if isinstance(rtype, str) and rtype in HOST_TYPES:
+                sub = rec.get(rtype)
+                if isinstance(sub, dict):
+                    return sub.get("address")
+        return None
+
+    def _kid_node(self, label: str) -> Optional["TreeNode"]:
+        return self.cache.nodes.get((label + "." + self.domain).lower())
+
+    @property
     def children(self) -> List["TreeNode"]:
-        return list(self.kids.values())
+        if not self.kids:
+            return []
+        out = []
+        for label in self.kids:
+            node = self._kid_node(label)
+            if node is not None:
+                out.append(node)
+        return out
 
     # -- watch event handlers --
 
     def on_children_changed(self, kids: List[str]) -> None:
-        self.cache.bump_gen()
-        if self.cache.m_watch_children is not None:
-            self.cache.m_watch_children.inc()
+        cache = self.cache
+        cache.bump_gen()
+        if cache.m_watch_children is not None:
+            cache.m_watch_children.inc()
         # answers that may change: this node's own (service answer sets
         # derive from children) and each newly appearing child's name
         # (a cached REFUSED for it is now wrong); removed subtrees emit
         # their own tags from unbind()
         tags = {self.domain}
-        new_kids: Dict[str, TreeNode] = {}
+        gone = set(self.kids or ())
+        changed = False
         for kid in kids:
-            existing = self.kids.pop(kid, None)
-            if existing is not None:
-                new_kids[kid] = existing
+            if kid in gone:
+                gone.discard(kid)       # survives: node stays as-is
             else:
-                node = TreeNode(self.cache, self.domain, kid)
-                new_kids[kid] = node
+                changed = True
+                node = TreeNode(cache, self.domain, kid)
                 tags.add(node.domain)
                 node.rebind()
-        for removed in list(self.kids.values()):
-            removed.unbind()
-        self.kids = new_kids
-        self.cache.invalidate(tags)
+        self.kids = tuple(kids) or None
+        for label in gone:
+            changed = True
+            removed = cache.nodes.get((label + "." + self.domain).lower())
+            if removed is not None:
+                removed.unbind()
+        if changed:
+            cache.invalidate(tags)
+        # unchanged child set (every re-delivery during a session
+        # rebuild walk): answers cannot have changed, so no
+        # invalidation work — at a million names, per-node invalidation
+        # during a re-mirror was the dominant rebuild cost (each event
+        # walks the native cache table)
 
     def on_data_changed(self, data: bytes) -> None:
-        self.cache.bump_gen()
-        if self.cache.m_watch_data is not None:
-            self.cache.m_watch_data.inc()
+        cache = self.cache
+        cache.bump_gen()
+        if cache.m_watch_data is not None:
+            cache.m_watch_data.inc()
         try:
             parsed = json.loads(data.decode("utf-8")) if data else None
         except (ValueError, UnicodeDecodeError) as e:
             self.log.warning("ignoring node %s: failed to parse data: %s",
                              self.path, e)
-            if self.cache.m_parse_failures is not None:
-                self.cache.m_parse_failures.inc()
+            if cache.m_parse_failures is not None:
+                cache.m_parse_failures.inc()
             return                      # old data kept: answers unchanged
         # JS typeof-object check admits dicts, lists, and null
         # (lib/zk.js:149-154); anything else is ignored, keeping old data.
@@ -132,24 +213,40 @@ class TreeNode:
             self.log.warning("ignoring node %s: parsed JSON is not an object",
                              self.path)
             return
+        # reverse-map upkeep around the record swap: drop the entry we
+        # own under the OLD address (never another node's — the
+        # collision guard), install under the new one.  A record that
+        # is no longer host-like simply yields ip None, so its entry
+        # drops and PTR can't serve a stale mapping.  The unchanged
+        # case (same address, entry already ours — every re-delivery
+        # during a session rebuild) must NOT del+reinsert: a million
+        # same-key delete/insert cycles force periodic O(zone) dict
+        # compactions, which is exactly the loop stall the chunked
+        # rebuild exists to avoid.
+        rec = _names.compact_record(parsed)
+        if rec == self._rec:
+            # identical record re-delivered — the shape of EVERY data
+            # event a session-rebuild walk fires: answers cannot have
+            # changed, so skip the invalidation fan-out entirely (the
+            # rebuild's epoch bump already revalidates every cached
+            # lane; per-name invalidation here was the dominant
+            # re-mirror cost at zone scale, one native-table walk per
+            # event).  The OLD object is kept on purpose: replacing a
+            # zone's worth of (gc-frozen) records with equal copies
+            # seeds gen-2 with survivors, and the eventual collection
+            # is a ~400 ms serving stall.
+            return
         old_ip = self.ip
-        self.data = parsed
-
-        rtype = parsed.get("type") if isinstance(parsed, dict) else None
-        if not isinstance(rtype, str) or rtype not in HOST_TYPES:
-            # no longer (or never was) a host-like record: drop any reverse
-            # entry we own so PTR can't serve a stale mapping
-            self._drop_rev_entry()
-        else:
-            record = parsed.get(rtype)
-            if not isinstance(record, dict):
-                self._drop_rev_entry()
-            else:
-                addr = record.get("address")
-                self._drop_rev_entry()
-                self.ip = addr
-                if addr:
-                    self.cache.rev_lookup[addr] = self
+        self._rec = rec
+        new_ip = self.ip
+        rev = cache.rev_lookup
+        if new_ip != old_ip:
+            if old_ip and rev.get(old_ip) is self:
+                del rev[old_ip]
+            if new_ip:
+                rev[new_ip] = self
+        elif new_ip and rev.get(new_ip) is not self:
+            rev[new_ip] = self          # re-claim a colliding entry
 
         # answers that may change: this name, the parent's (service
         # answer sets embed child data), and PTR answers for the old and
@@ -160,12 +257,7 @@ class TreeNode:
         for rev in (_rev_name(old_ip), _rev_name(self.ip)):
             if rev is not None:
                 tags.add(rev)
-        self.cache.invalidate(tags)
-
-    def _drop_rev_entry(self) -> None:
-        if self.ip and self.cache.rev_lookup.get(self.ip) is self:
-            del self.cache.rev_lookup[self.ip]
-        self.ip = None
+        cache.invalidate(tags)
 
     # -- lifecycle --
 
@@ -178,22 +270,29 @@ class TreeNode:
         again — with a synchronous store that would compound to 2^depth
         redundant rebinds per session event.
         """
-        existing = list(self.kids.values())
-        if self.watcher is not None:
-            self.watcher.clear()
-        self.watcher = self.cache.store.watcher(self.path)
-        self.watcher.on("children", self.on_children_changed)
-        self.watcher.on("data", self.on_data_changed)
+        existing = self.children
+        self.cache.store.bind_node(self.path, self)
         for kid in existing:
-            if self.kids.get(kid.name) is kid:
+            if self.cache.nodes.get(kid.domain) is kid:
                 kid.rebind()
+
+    def rebind_shallow(self, queue: deque) -> None:
+        """One node's share of a CHUNKED session rebuild: re-register
+        this node's watcher (new kids discovered by the resulting
+        children diff still bind recursively — they are new content the
+        mirror must pick up whole), then defer the surviving existing
+        kids onto the walk queue instead of recursing."""
+        existing = self.children
+        self.cache.store.bind_node(self.path, self)
+        for kid in existing:
+            if self.cache.nodes.get(kid.domain) is kid:
+                queue.append(kid)
 
     def unbind(self) -> None:
         self.cache.bump_gen()
         self.log.debug("unbinding node at %s", self.path)
-        if self.watcher is not None:
-            self.watcher.clear()
-        for kid in list(self.kids.values()):
+        self.cache.store.unbind_node(self.path, self)
+        for kid in self.children:
             kid.unbind()
         if self.cache.nodes.get(self.domain) is self:
             del self.cache.nodes[self.domain]
@@ -218,15 +317,32 @@ class MirrorCache:
     STORM_THRESHOLD = 500
     STORM_WINDOW = 1.0
 
+    #: chunked-rebuild pacing: one drain pass re-registers at least
+    #: REBUILD_MIN_CHUNK nodes and keeps going until the time budget is
+    #: spent, then yields the loop to serving.  The budget is checked
+    #: EVERY node past the floor — a node's rebind cost varies by three
+    #: orders of magnitude (leaf vs a parent with a thousand children),
+    #: so a count-based batch would stall the loop on parent-dense
+    #: stretches.  2 ms per pass keeps a million-name rebuild far under
+    #: the loop-lag watchdog's 250 ms stall threshold while still
+    #: converging in seconds.
+    REBUILD_BUDGET_S = 0.002
+    REBUILD_MIN_CHUNK = 1
+
     def __init__(self, store: StoreClient, domain: str,
                  log: Optional[logging.Logger] = None,
                  collector=None, recorder=None) -> None:
         self.store = store
-        self.domain = domain.lower()
+        self.domain = _names.intern_name(domain.lower())
         self.log = log or logging.getLogger("binder.cache")
         self.recorder = recorder
+        self.pool = _names.POOL
         self.nodes: Dict[str, TreeNode] = {}
         self.rev_lookup: Dict[str, TreeNode] = {}
+        # offer the node index as the store's direct event routing
+        # table (fake store / shard replica feed accept; real ZooKeeper
+        # declines and keeps per-path watchers)
+        getattr(store, "bind_source", lambda nodes: False)(self.nodes)
         # staleness instrumentation: monotonic instants of the last
         # applied mutation and the last full rebuild.  While the store
         # session is down no watch events arrive, so the mutation age
@@ -248,6 +364,15 @@ class MirrorCache:
         # invalidation (below) for ordinary mutations, so one churning
         # record no longer evicts every cached answer
         self.epoch = 0
+        # chunked-rebuild state: the walk queue (None when no rebuild
+        # is in flight), a generation guard so a session churning
+        # mid-rebuild restarts the walk instead of interleaving two,
+        # and the introspection counters the zone-scale bench reads
+        self._rebuild_queue: Optional[deque] = None
+        self._rebuild_gen = 0
+        self._rebuild_started: Optional[float] = None
+        self.rebuild_chunks = 0
+        self.last_rebuild_duration_s: Optional[float] = None
         # mutation subscribers (e.g. the balancer generation broadcast);
         # called synchronously on every bump — keep them cheap
         self._mutation_cbs: List = []
@@ -260,6 +385,7 @@ class MirrorCache:
         # lib/zk.js:26-38); all optional — tests build bare caches
         self.m_watch_children = self.m_watch_data = None
         self.m_parse_failures = self.m_rebuilds = None
+        self._m_rebuild_chunks = None
         if collector is not None:
             self.m_watch_children = collector.counter(
                 "binder_store_watch_events",
@@ -295,6 +421,31 @@ class MirrorCache:
                 "age of the last change applied to the store mirror "
                 "(bounds answer staleness while the session is down)"
             ).set_function(lambda: self.staleness_seconds() or 0.0)
+            # zone-scale family (ISSUE 7, docs/observability.md): every
+            # figure the large-zone runbook sizes against is scrapeable
+            collector.gauge(
+                "binder_mirror_names",
+                "names (domain nodes) resident in the mirror"
+            ).set_function(lambda: float(len(self.nodes)))
+            collector.gauge(
+                "binder_mirror_interned_names",
+                "canonical name/label objects in the interned-name pool"
+            ).set_function(lambda: float(len(self.pool)))
+            collector.gauge(
+                "binder_mirror_rebuild_pending",
+                "nodes awaiting re-bind in the chunked session rebuild "
+                "(0 when no rebuild is in flight)"
+            ).set_function(lambda: float(self.rebuild_pending()))
+            collector.gauge(
+                "binder_mirror_rebuild_seconds",
+                "wall-clock duration of the last completed session "
+                "rebuild").set_function(
+                    lambda: self.last_rebuild_duration_s or 0.0)
+            self._m_rebuild_chunks = collector.counter(
+                "binder_mirror_rebuild_chunks",
+                "event-loop passes spent draining chunked session "
+                "rebuilds").labelled()
+            self._m_rebuild_chunks.inc(0)
         store.on_session(self.rebuild)
 
     def on_mutation(self, cb) -> None:
@@ -361,6 +512,18 @@ class MirrorCache:
     def lookup(self, domain: str) -> Optional[TreeNode]:
         return self.nodes.get(domain)
 
+    def canon(self, name: str) -> str:
+        """The canonical object for *name*: the mirror's own domain
+        string when the name is mirrored (the nodes index is the
+        canonical home for mirrored names), else the process-wide
+        interned-name pool.  The answer cache's tag index and the
+        compiled-answer table intern through this, so a name is ONE
+        object no matter how many layers index it."""
+        node = self.nodes.get(name)
+        if node is not None:
+            return node.domain
+        return _names.intern_name(name)
+
     def reverse_lookup(self, ip: str) -> Optional[TreeNode]:
         return self.rev_lookup.get(ip)
 
@@ -402,9 +565,21 @@ class MirrorCache:
         query.stamp("store-lookup")
         return node
 
+    # -- session rebuild (chunked at zone scale) --
+
     def rebuild(self) -> None:
         """Re-mirror from scratch-or-current on (re)session
-        (lib/zk.js:68-76)."""
+        (lib/zk.js:68-76).
+
+        The walk over EXISTING nodes is chunked: each event-loop pass
+        re-registers a time-budgeted batch of watchers and yields, so
+        serving (from the still-resident node data) continues and the
+        loop-lag watchdog stays quiet through a million-name re-mirror.
+        Brand-new subtrees discovered along the way still bind
+        synchronously — they are unmirrored content.  Without a running
+        loop (synchronous stores, tests, startup before serving) the
+        drain runs inline to completion, preserving the historical
+        fully-synchronous semantics."""
         if self.m_rebuilds is not None:
             self.m_rebuilds.inc()
         self.last_rebuild_mono = time.monotonic()
@@ -418,7 +593,68 @@ class MirrorCache:
         if tn is None:
             parts = self.domain.split(".")
             tn = TreeNode(self, ".".join(parts[1:]), parts[0])
-        tn.rebind()
+        self._rebuild_gen += 1
+        self._rebuild_started = time.perf_counter()
+        self._rebuild_queue = deque((tn,))
+        self._drain_rebuild(self._rebuild_gen)
+
+    def rebuild_pending(self) -> int:
+        """Nodes still awaiting re-bind in the in-flight chunked
+        rebuild (0 when none is running)."""
+        q = self._rebuild_queue
+        return len(q) if q is not None else 0
+
+    def rebuild_info(self) -> dict:
+        """Introspection block for the /status mirror section."""
+        return {
+            "pending": self.rebuild_pending(),
+            "chunks": self.rebuild_chunks,
+            "last_duration_seconds": self.last_rebuild_duration_s,
+        }
+
+    def _drain_rebuild(self, gen: int) -> None:
+        q = self._rebuild_queue
+        while q and gen == self._rebuild_gen:
+            t0 = time.perf_counter()
+            n = 0
+            self.rebuild_chunks += 1
+            if self._m_rebuild_chunks is not None:
+                self._m_rebuild_chunks.inc()
+            while q and gen == self._rebuild_gen:
+                node = q.popleft()
+                if self.nodes.get(node.domain) is not node:
+                    continue            # subtree left mid-walk
+                node.rebind_shallow(q)
+                n += 1
+                if (n >= self.REBUILD_MIN_CHUNK
+                        and time.perf_counter() - t0
+                        >= self.REBUILD_BUDGET_S):
+                    break
+            if not q or gen != self._rebuild_gen:
+                break
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                continue                # no loop: drain inline
+            loop.call_soon(self._rebuild_tick, gen)
+            return
+        if gen != self._rebuild_gen:
+            return                      # superseded by a newer rebuild
+        self._rebuild_queue = None
+        if self._rebuild_started is not None:
+            self.last_rebuild_duration_s = (time.perf_counter()
+                                            - self._rebuild_started)
+            self._rebuild_started = None
+        if self.recorder is not None:
+            self.recorder.record(
+                "mirror-rebuild-done", epoch=self.epoch,
+                nodes=len(self.nodes), chunks=self.rebuild_chunks,
+                duration_s=round(self.last_rebuild_duration_s or 0.0, 4))
+
+    def _rebuild_tick(self, gen: int) -> None:
+        if gen != self._rebuild_gen:
+            return
+        self._drain_rebuild(gen)
 
     def stop(self) -> None:
         self.store.close()

@@ -36,6 +36,13 @@ class FakeStore(SessionStateMixin, StoreClient):
         self._init_session_state(recorder)
         self._root = _Node()
         self._watchers: Dict[str, Watcher] = {}
+        # mirror fast binding: registered node SOURCES (a MirrorCache's
+        # domain->TreeNode index).  Events route straight to the bound
+        # node by domain — no Watcher object, no stored path string, no
+        # binding dict of our own: the mirror's node index IS the watch
+        # table, so the per-znode watch costs literally nothing extra.
+        # That is what makes a million-name mirror affordable.
+        self._sources: List[Dict[str, object]] = []
         self._session_cbs: List[Callable[[], None]] = []
         self._connected = False
 
@@ -52,6 +59,28 @@ class FakeStore(SessionStateMixin, StoreClient):
             w = _FakeWatcher(self, path)
             self._watchers[path] = w
         return w
+
+    def bind_source(self, nodes: Dict[str, object]) -> bool:
+        """Register a mirror's domain->node index as the watch table:
+        fired events route to ``nodes[domain]`` directly."""
+        if nodes not in self._sources:
+            self._sources.append(nodes)
+        return True
+
+    def bind_node(self, path: str, node) -> None:
+        """With source routing the bind itself is just the initial
+        state delivery — membership in the mirror's node index (the
+        registered source) is what keeps events flowing."""
+        n = self._find(path)
+        if n is None or not self._connected:
+            return
+        # same delivery order as the generic watcher path: children
+        # (creating the kid nodes) before data
+        node.on_children_changed(sorted(n.children))
+        node.on_data_changed(n.data)
+
+    def unbind_node(self, path: str, node) -> None:
+        """No-op: unbinding is the node leaving its mirror's index."""
 
     def is_connected(self) -> bool:
         return self._connected
@@ -172,18 +201,36 @@ class FakeStore(SessionStateMixin, StoreClient):
     # -- watch plumbing --
 
     def _fire_children(self, path: str, node: _Node) -> None:
+        if not self._connected:
+            return
         w = self._watchers.get(path)
-        if w is not None and self._connected:
+        if w is not None:
             w.emit("children", sorted(node.children))
+        if self._sources:
+            dom = _path_domain(path)
+            for src in self._sources:
+                tn = src.get(dom)
+                if tn is not None:
+                    tn.on_children_changed(sorted(node.children))
 
     def _fire_data(self, path: str, node: _Node) -> None:
+        if not self._connected:
+            return
         w = self._watchers.get(path)
-        if w is not None and self._connected:
+        if w is not None:
             w.emit("data", node.data)
+        if self._sources:
+            dom = _path_domain(path)
+            for src in self._sources:
+                tn = src.get(dom)
+                if tn is not None:
+                    tn.on_data_changed(node.data)
 
 
 class _FakeWatcher(Watcher):
     """Watcher that delivers current state as soon as a listener attaches."""
+
+    __slots__ = ("_store",)
 
     def __init__(self, store: FakeStore, path: str) -> None:
         super().__init__(path)
@@ -199,9 +246,66 @@ class _FakeWatcher(Watcher):
         elif event == "data":
             cb(node.data)
 
+    def bind_node(self, tn) -> None:
+        super().bind_node(tn)
+        node = self._store._find(self.path)
+        if node is None or not self._store._connected:
+            return
+        # same delivery order as two on() calls: children (creating the
+        # kid nodes) before data
+        tn.on_children_changed(sorted(node.children))
+        tn.on_data_changed(node.data)
+
+
+def populate_synthetic(store: FakeStore, domain: str, hosts: int,
+                       racks: int = 0,
+                       subtree: str = "zs") -> int:
+    """Bulk-build a synthetic production-scale zone directly into the
+    store tree (bench/smoke surface, ISSUE 7 zone_scale axis): ``hosts``
+    host records spread across ``racks`` service-style parents under
+    ``<subtree>.<domain>``, with deterministic unique addresses.
+
+    Builds by direct tree insertion — watcher firing is pointless
+    before a session starts, and at a million names the per-node
+    ``mkdirp`` path walk would dominate the build.  Call BEFORE
+    ``start_session()``; the mirror picks the whole zone up on its
+    initial build.  Returns the number of host nodes created."""
+    if racks <= 0:
+        racks = max(1, min(1024, hosts // 512))
+    base = [p for p in reversed((subtree + "." + domain).split("."))
+            if p]
+    node = store._root
+    for part in base:
+        nxt = node.children.get(part)
+        if nxt is None:
+            nxt = _Node()
+            node.children[part] = nxt
+        node = nxt
+    rack_nodes = []
+    for r in range(racks):
+        rn = _Node(b'{"type": "service", "service": {"srvce": "_zs", '
+                   b'"proto": "_tcp", "port": 80}}')
+        node.children[f"r{r:04d}"] = rn
+        rack_nodes.append(rn)
+    for i in range(hosts):
+        addr = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
+        rack_nodes[i % racks].children[f"h{i:06d}"] = _Node(
+            b'{"type": "host", "host": {"address": "%s"}}'
+            % addr.encode())
+    return hosts
+
 
 def _parts(path: str) -> List[str]:
     return [p for p in path.split("/") if p]
+
+
+def _path_domain(path: str) -> str:
+    """``/com/foo/web -> web.foo.com`` — the (case-preserving) inverse
+    of ``cache.domain_to_path``, used to route fired events to bound
+    mirror nodes.  Case sensitivity matches the historical exact-path
+    watcher match: a store path whose case differs from the mirror's
+    lowercased registration never matched before and still doesn't."""
+    return ".".join(reversed([p for p in path.split("/") if p]))
 
 
 def _split(path: str) -> Tuple[str, str]:

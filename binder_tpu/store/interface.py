@@ -105,28 +105,93 @@ class Watcher:
 
     Mirrors zkstream's watcher EventEmitter surface (``childrenChanged`` /
     ``dataChanged``) as used at reference ``lib/zk.js:215-219``.
+
+    Storage is deliberately compact (one watcher per mirrored znode
+    means a million of these at production zone scale): slots instead
+    of a ``__dict__``, and each event's listeners held as None / the
+    single callback / a tuple — the mirror registers exactly one per
+    event, so the common case allocates no container at all.  The
+    ``_listeners`` dict view is materialized on demand for
+    introspection and tests.
     """
+
+    __slots__ = ("path", "_children", "_data")
 
     def __init__(self, path: str) -> None:
         self.path = path
-        self._listeners: Dict[str, List[Callable]] = {"children": [], "data": []}
+        self._children = None
+        self._data = None
+
+    @staticmethod
+    def _add(slot, cb):
+        if slot is None:
+            return cb
+        if type(slot) is tuple:
+            return slot + (cb,)
+        return (slot, cb)
 
     def on(self, event: str, cb: Callable) -> None:
-        self._listeners[event].append(cb)
+        if event == "children":
+            self._children = self._add(self._children, cb)
+        elif event == "data":
+            self._data = self._add(self._data, cb)
+        else:
+            raise KeyError(event)
+
+    def bind_node(self, node) -> None:
+        """Attach a mirror TreeNode as the listener for BOTH events.
+
+        The node object itself is stored and its
+        ``on_children_changed``/``on_data_changed`` handlers are
+        resolved at emit time — one reference instead of two
+        bound-method objects, which at one watcher per znode is tens of
+        MB at production zone scale.  Subclasses that deliver initial
+        state on listener attach must override this the same way they
+        override ``on``."""
+        self._children = self._add(self._children, node)
+        self._data = self._add(self._data, node)
 
     def clear(self) -> None:
         """Remove all listeners (reference removeAllListeners,
         ``lib/zk.js:211-214``)."""
-        for lst in self._listeners.values():
-            lst.clear()
+        self._children = None
+        self._data = None
+
+    @staticmethod
+    def _resolve(entry, event: str) -> Callable:
+        if callable(entry):
+            return entry
+        return (entry.on_children_changed if event == "children"
+                else entry.on_data_changed)
 
     def emit(self, event: str, *args) -> None:
-        for cb in list(self._listeners[event]):
-            cb(*args)
+        slot = self._children if event == "children" else self._data
+        if slot is None:
+            return
+        if type(slot) is tuple:
+            for entry in slot:
+                self._resolve(entry, event)(*args)
+        else:
+            self._resolve(slot, event)(*args)
+
+    @property
+    def _listeners(self) -> Dict[str, List[Callable]]:
+        """Dict-of-lists view of the compact listener slots (kept for
+        tests/introspection; mutations to the view are NOT applied)."""
+        out = {}
+        for event, slot in (("children", self._children),
+                            ("data", self._data)):
+            if slot is None:
+                out[event] = []
+            elif type(slot) is tuple:
+                out[event] = [self._resolve(e, event) for e in slot]
+            else:
+                out[event] = [self._resolve(slot, event)]
+        return out
 
     @property
     def has_listeners(self) -> bool:
-        return any(self._listeners.values())
+        return self._children is not None or self._data is not None
 
 
 class StoreClient:
@@ -144,6 +209,40 @@ class StoreClient:
         changes, for as long as the session lasts.
         """
         raise NotImplementedError
+
+    # -- mirror-node fast binding --
+    #
+    # The mirror registers EXACTLY one listener pair per znode — one
+    # TreeNode.  The generic path (watcher object + listener slots) is
+    # ~190 bytes per node, which at a million names is the difference
+    # between a mirror that fits and one that doesn't.  Stores that can
+    # route events straight to a bound node (the fake store and the
+    # shard replica feed) override these with a bare domain->node dict;
+    # the default keeps the historical watcher semantics for real
+    # ZooKeeper (whose one-shot wire watches need the re-registration
+    # machinery anyway).
+
+    def bind_source(self, nodes) -> bool:
+        """Offer the mirror's domain->node index as a direct event
+        routing table.  Stores that can route events by domain (the
+        fake store, and through it the shard replica feed) accept and
+        return True — per-node binds then carry no per-node state at
+        all.  The default declines; such stores keep per-path watcher
+        objects (real ZooKeeper needs them for its one-shot wire
+        watches)."""
+        return False
+
+    def bind_node(self, path: str, node) -> None:
+        """Bind *node* as the sole listener for *path*: clears any
+        previous listeners, attaches the node for both events, and
+        delivers current state (same contract as two ``on`` calls)."""
+        w = self.watcher(path)
+        w.clear()
+        w.bind_node(node)
+
+    def unbind_node(self, path: str, node) -> None:
+        """Detach *node* from *path* (no-op if it is not bound)."""
+        self.watcher(path).clear()
 
     def is_connected(self) -> bool:
         raise NotImplementedError

@@ -48,6 +48,8 @@ CONNECT_TIMEOUT = 3.0
 class _ZKWatcher(Watcher):
     """Watcher whose listener attachment triggers a watched fetch."""
 
+    __slots__ = ("_client",)
+
     def __init__(self, client: "ZKClient", path: str) -> None:
         super().__init__(path)
         self._client = client
@@ -55,6 +57,11 @@ class _ZKWatcher(Watcher):
     def on(self, event: str, cb: Callable) -> None:
         super().on(event, cb)
         self._client._schedule_sync(self.path, event)
+
+    def bind_node(self, tn) -> None:
+        super().bind_node(tn)
+        self._client._schedule_sync(self.path, "children")
+        self._client._schedule_sync(self.path, "data")
 
 
 def parse_connect_string(address: str, default_port: int

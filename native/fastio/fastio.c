@@ -292,6 +292,10 @@ static PyMethodDef fastio_methods[] = {
      "fastpath_stats(cache) -> dict"},
     {"fastpath_clear", fastpath_clear, METH_VARARGS,
      "fastpath_clear(cache) -> None"},
+    {"fastpath_zone_reserve", fastpath_zone_reserve, METH_VARARGS,
+     "fastpath_zone_reserve(cache, expected_entries) -> None "
+     "(presize the zone table so a bulk fill never rehashes "
+     "mid-serving)"},
     {"fastpath_invalidate", fastpath_invalidate, METH_VARARGS,
      "fastpath_invalidate(cache, tag_qname_wire) -> dropped count"},
     {"fastpath_invalidate_many", fastpath_invalidate_many, METH_VARARGS,

@@ -533,6 +533,25 @@ fastpath_serve_frames(PyObject *self, PyObject *args)
 }
 
 PyObject *
+fastpath_zone_reserve(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    unsigned long entries;
+
+    if (!PyArg_ParseTuple(args, "Ok", &capsule, &entries))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL)
+        return NULL;
+    if (entries > FP_ZONE_MAX_SLOTS)
+        entries = FP_ZONE_MAX_SLOTS;
+    if (fp_zone_reserve(c, &c->zmain, (uint32_t)entries) != 0)
+        return PyErr_NoMemory();
+    Py_RETURN_NONE;
+}
+
+PyObject *
 fastpath_invalidate(PyObject *self, PyObject *args)
 {
     (void)self;

@@ -31,6 +31,7 @@ PyObject *fastpath_serve_frames(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);
+PyObject *fastpath_zone_reserve(PyObject *self, PyObject *args);
 PyObject *fastpath_invalidate(PyObject *self, PyObject *args);
 PyObject *fastpath_invalidate_many(PyObject *self, PyObject *args);
 PyObject *fastpath_log_enable(PyObject *self, PyObject *args);

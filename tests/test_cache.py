@@ -169,15 +169,23 @@ class TestSessionChurn:
 
     def test_no_duplicate_event_delivery_after_rebinds(self):
         """Rebinding N times must not register N listeners (lib/zk.js
-        clears listeners before re-adding; leak hazard in SURVEY §7.3)."""
+        clears listeners before re-adding; leak hazard in SURVEY §7.3).
+        The mirror binds through the store's single-slot node binding,
+        so duplication would show up as multiple deliveries per fired
+        event."""
         store, cache = make_cache()
         store.start_session()
         store.put_json("/com/foo/web", host("10.0.0.5"))
         for _ in range(5):
             store.expire_session()
-        w = store.watcher(domain_to_path("web.foo.com"))
-        assert len(w._listeners["children"]) == 1
-        assert len(w._listeners["data"]) == 1
+        # exactly one bound listener: one data event -> exactly one
+        # application (one generation bump), not 2^rebinds
+        gen0 = cache.gen
+        store.set_data("/com/foo/web",
+                       b'{"type": "host", "host": {"address": "10.0.0.6"}}')
+        assert cache.gen - gen0 == 1
+        assert cache.lookup("web.foo.com").data["host"]["address"] \
+            == "10.0.0.6"
 
     def test_removed_subtree_watchers_are_silent(self):
         store, cache = make_cache()
@@ -219,16 +227,16 @@ class TestReviewRegressions:
             path += f"/{label}"
             store.put_json(path, host("10.9.9.9") if label == "f" else
                            {"type": "service", "service": {"port": 1}})
-        deep = store.watcher("/com/foo/a/b/c/d/e/f")
         calls = {"n": 0}
-        orig_emit = deep.emit
+        orig_bind = store.bind_node
 
-        def counting_emit(event, *args):
-            calls["n"] += 1
-            orig_emit(event, *args)
+        def counting_bind(path, node):
+            if path == "/com/foo/a/b/c/d/e/f":
+                calls["n"] += 1
+            orig_bind(path, node)
 
-        deep.emit = counting_emit
-        baseline = calls["n"]
+        store.bind_node = counting_bind
         store.expire_session()
-        # one rebind -> at most a couple of initial-state deliveries
-        assert calls["n"] - baseline <= 4, calls["n"] - baseline
+        # one session rebuild -> the deep node is re-bound exactly once
+        # (each bind delivers initial children+data state)
+        assert calls["n"] <= 2, calls["n"]

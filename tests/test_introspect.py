@@ -639,8 +639,11 @@ class TestSnapshotValidator:
                       "session_establishments": 1, "transitions": []},
             "mirror": {"ready": True, "domain": "foo.com",
                        "generation": 1, "epoch": 1, "nodes": 2,
-                       "reverse_entries": 1, "staleness_seconds": 0.5,
-                       "last_rebuild_age_seconds": None},
+                       "names": 2, "reverse_entries": 1,
+                       "interned_names": 3, "staleness_seconds": 0.5,
+                       "last_rebuild_age_seconds": None,
+                       "rebuild": {"pending": 0, "chunks": 1,
+                                   "last_duration_seconds": 0.01}},
             "answer_cache": {"size": 10, "entries": 0, "hits": 0,
                              "misses": 0, "hit_ratio": 0.0,
                              "invalidations": 0, "expiry_ms": 1000.0,

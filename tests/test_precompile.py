@@ -236,7 +236,7 @@ class TestWireParity:
         return srv1, srv2
 
     def assert_parity(self, name, qtype, load, edns=1232, rd=False,
-                      prime=False):
+                      prime=False, perturb=None):
         """``prime=True`` for shapes only reachable through the
         dropped-key path (concrete negative qnames): ask once lazily so
         the question identity is cached, then mutate so the
@@ -245,7 +245,16 @@ class TestWireParity:
         if prime:
             s1 = srv_pre.zk_cache.store
             ask(srv_pre, name, qtype, qid=99, edns=edns, rd=rd)
-            load(s1)                    # re-put == mutation event
+            # a REAL mutation (identical re-puts no longer invalidate:
+            # unchanged data cannot change answers), restored to the
+            # canonical fixture so the parity comparison holds
+            if perturb is None:
+                perturb = lambda s: s.put_json(  # noqa: E731
+                    SVC, {"type": "service",
+                          "service": {"srvce": "_pg", "proto": "_tcp",
+                                      "port": 5433}})
+            perturb(s1)
+            load(s1)                    # restore == second mutation
         forbid_engine(srv_pre)
         _, wire_pre, q = ask(srv_pre, name, qtype, qid=3, edns=edns,
                              rd=rd)
@@ -273,10 +282,12 @@ class TestWireParity:
 
     def test_nodata_soa_parity(self):
         load = lambda s: put_host(s, "/com/foo/web", "10.1.2.3", ttl=60)
+        touch = lambda s: put_host(s, "/com/foo/web", "10.9.9.9",
+                                   ttl=60)
         self.assert_parity("_pg._tcp.web.foo.com", Type.SRV, load,
-                           prime=True)
+                           prime=True, perturb=touch)
         self.assert_parity("_pg._tcp.web.foo.com", Type.SRV, load,
-                           edns=None, prime=True)
+                           edns=None, prime=True, perturb=touch)
 
     def test_nxdomain_parity(self):
         self.assert_parity("_http._udp.svc.foo.com", Type.SRV,
@@ -343,7 +354,9 @@ class TestStormShedding:
         async def run():
             store, cache, server = build(recorder=recorder)
             pc = server._precompiler
-            pc.MAX_PENDING = 4          # instance shadow of the bound
+            # instance shadow of the bound (the cap too: the effective
+            # bound scales with zone size up to MAX_PENDING_CAP)
+            pc.MAX_PENDING = pc.MAX_PENDING_CAP = 4
             # 40 served names (the evidence that makes their mutations
             # re-render work)
             for i in range(40):
@@ -382,7 +395,7 @@ class TestStormShedding:
                 put_host(store, f"/com/foo/b{i}", f"10.2.0.{i + 1}")
                 ask(server, f"b{i}.foo.com", Type.A, qid=i + 1)
             await asyncio.sleep(0)
-            pc.MAX_PENDING = 2
+            pc.MAX_PENDING = pc.MAX_PENDING_CAP = 2
             for i in range(10):
                 put_host(store, f"/com/foo/b{i}", f"10.3.0.{i + 1}")
             assert pc.shed > 0
@@ -391,6 +404,7 @@ class TestStormShedding:
             # a fresh mutation of a (possibly shed) name re-renders it
             # normally once the storm is over and the bound is back
             pc.MAX_PENDING = type(pc).MAX_PENDING
+            pc.MAX_PENDING_CAP = type(pc).MAX_PENDING_CAP
             ask(server, "b9.foo.com", Type.A, qid=90)   # evidence again
             put_host(store, "/com/foo/b9", "10.2.9.9")
             while pc._pending:

@@ -450,9 +450,12 @@ _SNAPSHOT_SCHEMA = {
     "mirror": {
         "ready": (bool, False), "domain": (str, True),
         "generation": (int, False), "epoch": (int, False),
-        "nodes": (int, False), "reverse_entries": (int, False),
+        "nodes": (int, False), "names": (int, False),
+        "reverse_entries": (int, False),
+        "interned_names": (int, False),
         "staleness_seconds": (_NUM, True),
         "last_rebuild_age_seconds": (_NUM, True),
+        "rebuild": (dict, False),
     },
     "answer_cache": {
         "size": (int, False), "entries": (int, False),
@@ -566,10 +569,16 @@ def validate_status_snapshot(snap):
                 if isinstance(ev, dict)]
         if seqs != sorted(seqs):
             errs.append("flight_recorder.events: seq not ascending")
+    mirror = snap.get("mirror")
+    if isinstance(mirror, dict) and isinstance(mirror.get("rebuild"),
+                                               dict):
+        for key in ("pending", "chunks", "last_duration_seconds"):
+            if key not in mirror["rebuild"]:
+                errs.append(f"mirror.rebuild: missing {key!r}")
     pc = snap.get("precompile")
     if isinstance(pc, dict):
         for key in ("queue_depth", "max_pending", "batch", "compiled",
-                    "declined", "shed"):
+                    "declined", "shed", "seed_remaining"):
             if key not in pc:
                 errs.append(f"precompile: missing {key!r}")
     pol = snap.get("policy")
@@ -822,6 +831,71 @@ def validate_shard_metrics(text):
                     errs.append(f"{family}: sample missing the "
                                 f"`shard` label")
                     break
+    return errs
+
+
+# -- mirror / zone-scale metrics (ISSUE 7, docs/observability.md) ------
+#
+# The million-name story is told by the binder_mirror_* family (name
+# count, interned-pool size, chunked-rebuild progress/duration) plus
+# binder_udp_late_drops_total (late responses dropped at a full socket
+# buffer — the drop path that used to be a silent debug line).  Every
+# family must carry the right TYPE and at least one sample, and none of
+# the per-binder series may carry stray labels (an accidental label
+# would split the one-series-per-process contract PromQL dashboards sum
+# over).  Wired into tier-1 via tests/test_zone_scale.py and into
+# `make zone-smoke`.
+
+_MIRROR_FAMILIES = {
+    "binder_mirror_staleness_seconds": "gauge",
+    "binder_mirror_names": "gauge",
+    "binder_mirror_interned_names": "gauge",
+    "binder_mirror_rebuild_pending": "gauge",
+    "binder_mirror_rebuild_seconds": "gauge",
+    "binder_mirror_rebuild_chunks": "counter",
+    "binder_udp_late_drops_total": "counter",
+}
+
+#: labels the collector's static set may legitimately add to every
+#: series; anything else on a mirror-family sample is a pin violation
+_MIRROR_ALLOWED_LABELS = frozenset(
+    ("datacenter", "instance", "server", "service", "port"))
+
+
+def validate_mirror_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_mirror_*`` / zone-scale family (plus the late-drop
+    counter): correct TYPE declarations, at least one sample each, and
+    no labels beyond the collector's static set.  Returns error
+    strings; empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            name, _, labels = parts[0].partition("{")
+            samples.setdefault(name, []).append(labels)
+    for family, kind in _MIRROR_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in samples:
+            errs.append(f"{family}: no samples in exposition")
+            continue
+        for labels in samples[family]:
+            names = {pair.partition("=")[0]
+                     for pair in labels.partition("}")[0].split(",")
+                     if pair}
+            stray = names - _MIRROR_ALLOWED_LABELS
+            if stray:
+                errs.append(f"{family}: unexpected label(s) "
+                            f"{sorted(stray)}")
+                break
     return errs
 
 
