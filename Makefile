@@ -4,7 +4,8 @@
 PY ?= python3
 
 .PHONY: all native test check ci bench bench-smoke status-smoke \
-	chaos-smoke tcp-smoke shard-smoke zone-smoke real-tiers clean
+	chaos-smoke tcp-smoke shard-smoke zone-smoke federation-smoke \
+	real-tiers clean
 
 all: native
 
@@ -55,6 +56,7 @@ ci:
 	$(MAKE) tcp-smoke
 	BINDER_SHARD_SECONDS=10 $(MAKE) shard-smoke
 	BINDER_ZONE_NAMES=20000 $(MAKE) zone-smoke
+	BINDER_FEDERATION_SECONDS=10 $(MAKE) federation-smoke
 	@echo "ci: all gates passed"
 
 # one fast reduced-iteration bench pass proving the measured paths still
@@ -106,6 +108,17 @@ shard-smoke:
 # BINDER_ZONE_NAMES overrides the size (make ci trims to 20k)
 zone-smoke:
 	$(PY) tools/zone_smoke.py
+
+# federation end-to-end smoke: two in-process DC groups over real
+# loopback UDP, scripted whole-DC loss mid-load — local names stay
+# line-rate, cached foreign names serve stale (TTL-clamped NOERROR),
+# uncached ones get a well-formed REFUSED, zero client-visible
+# timeouts; plus binder_federation_* exposition, /status + bstat
+# federation sections, and the failover flight events
+# (docs/federation.md); BINDER_FEDERATION_SECONDS overrides the
+# duration (make ci trims to 10 s)
+federation-smoke:
+	$(PY) tools/federation_smoke.py
 
 # stream-lane end-to-end smoke: one-shot (accept fast path), pipelined
 # promotion + write coalescing, slow-reader disconnect at the

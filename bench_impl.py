@@ -1173,6 +1173,12 @@ def _bench_recursion(tmpdir: str) -> Dict[str, float]:
                        "store": {"backend": "fake",
                                  "fixture": local_fixture},
                        "queryLog": False,
+                       # dnsblast is one src IP — exactly the flood
+                       # shape per-client admission sheds.  Lift the
+                       # recursion rate limit so the axis measures
+                       # forwarding, not REFUSED generation.
+                       "admission": {"recursionRate": 1e9,
+                                     "recursionBurst": 1e9},
                        "recursion": {
                            "dcs": {"remotedc":
                                    [f"127.0.0.2:{rport}"]}}}, f)
@@ -1206,6 +1212,133 @@ def _bench_recursion(tmpdir: str) -> Dict[str, float]:
             print(f"bench: recursion attribution scrape failed: {e!r}",
                   file=sys.stderr)
         return res
+    finally:
+        for p in (local, remote):
+            if p is not None:
+                _reap(p)
+
+
+def _bench_cross_dc(tmpdir: str) -> Dict[str, object]:
+    """Federation axis (ISSUE 11): ONE federated binder whose routing
+    table comes from its watched /dcs registry, serving its own
+    mirror's names and forwarding names owned by a 'west' DC on
+    127.0.0.2 — foreign vs local p50/p99 through the same process.
+    Then the whole west DC is killed and the failover convergence is
+    measured: elapsed until a foreign name is answered again (stale,
+    TTL-clamped) instead of waiting on a dead peer."""
+    remote_fix = {f"/com/bench/west/w{i}": {
+        "type": "host", "host": {"address": f"10.30.0.{i + 1}",
+                                 "ttl": 60}}
+        for i in range(64)}
+    remote_fixture = os.path.join(tmpdir, "fed_remote_fixture.json")
+    with open(remote_fixture, "w") as f:
+        json.dump(remote_fix, f)
+    remote_config = os.path.join(tmpdir, "fed_remote_config.json")
+    with open(remote_config, "w") as f:
+        json.dump({"dnsDomain": "bench.com", "datacenterName": "west",
+                   "host": "127.0.0.2",
+                   "store": {"backend": "fake",
+                             "fixture": remote_fixture},
+                   "queryLog": False}, f)
+
+    remote = local = None
+    try:
+        remote = _launch_server(remote_config)
+        rport = wait_for_port(remote)
+
+        local_fix = {
+            **{f"/com/bench/east/l{i}": {
+                "type": "host", "host": {"address": f"10.31.0.{i + 1}",
+                                         "ttl": 30}}
+               for i in range(64)},
+            # DC membership rides the same store the mirror watches
+            "/dcs/east": {"zones": ["east"], "peers": []},
+            "/dcs/west": {"zones": ["west"],
+                          "peers": [f"127.0.0.2:{rport}"]},
+        }
+        local_fixture = os.path.join(tmpdir, "fed_local_fixture.json")
+        with open(local_fixture, "w") as f:
+            json.dump(local_fix, f)
+        local_config = os.path.join(tmpdir, "fed_local_config.json")
+        with open(local_config, "w") as f:
+            json.dump({"dnsDomain": "bench.com",
+                       "datacenterName": "east", "host": "127.0.0.1",
+                       "store": {"backend": "fake",
+                                 "fixture": local_fixture},
+                       "queryLog": False,
+                       # single-source load generator: lift the
+                       # per-client recursion rate limit (see
+                       # _bench_recursion) so foreign-name numbers
+                       # measure forwarding, not admission sheds
+                       "admission": {"recursionRate": 1e9,
+                                     "recursionBurst": 1e9},
+                       "federation": {"staleTtlClampSeconds": 15}}, f)
+        local = _launch_server(local_config)
+        port, _mport = wait_for_ports(local)
+
+        ftmpl = os.path.join(tmpdir, "fed_foreign.bin")
+        _write_templates(
+            ftmpl, [(f"w{i}.west.bench.com", Type.A)
+                    for i in range(64)], rd=True)
+        ltmpl = os.path.join(tmpdir, "fed_local.bin")
+        _write_templates(
+            ltmpl, [(f"l{i}.east.bench.com", Type.A)
+                    for i in range(64)])
+
+        _wait_ready(port, make_query("w0.west.bench.com", Type.A,
+                                     qid=1, rd=True).encode(),
+                    "cross-DC forwarding")
+        _wait_ready(port, make_query("l0.east.bench.com", Type.A,
+                                     qid=1).encode(), "local mirror")
+
+        foreign = _median_passes(
+            lambda: _drive_native(port, tmpdir, tmpl_path=ftmpl,
+                                  n=N_RECURSION), N_PASSES)
+        local_res = _median_passes(
+            lambda: _drive_native(port, tmpdir, tmpl_path=ltmpl,
+                                  n=N_RECURSION), N_PASSES)
+
+        # -- failover convergence: kill the WHOLE west DC, then time
+        # until a (cache-warm) foreign name answers NOERROR again —
+        # the stale-serve path, measured with fresh one-shot sockets
+        # so a dead-peer wait shows up as elapsed time, not a hang
+        _reap(remote)
+        remote = None
+        probe = make_query("w1.west.bench.com", Type.A, qid=2,
+                           rd=True).encode()
+        start = time.time()
+        deadline = start + 30.0
+        convergence_ms = None
+        while time.time() < deadline:
+            s = _socket_mod.socket(_socket_mod.AF_INET,
+                                   _socket_mod.SOCK_DGRAM)
+            s.settimeout(1.0)
+            s.connect(("127.0.0.1", port))
+            try:
+                s.send(probe)
+                resp = s.recv(512)
+                if not (resp[3] & 0x0F) and resp[6:8] != b"\x00\x00":
+                    convergence_ms = (time.time() - start) * 1e3
+                    break
+            except _socket_mod.timeout:
+                pass
+            finally:
+                s.close()
+        if convergence_ms is None:
+            raise RuntimeError("foreign names never converged to "
+                               "stale serving after DC loss")
+        return {
+            "foreign_qps": round(foreign["qps"], 1),
+            "foreign_qps_spread": foreign.get("qps_spread"),
+            "foreign_p50_us": round(foreign["p50_us"], 1),
+            "foreign_p99_us": round(foreign["p99_us"], 1),
+            "local_qps": round(local_res["qps"], 1),
+            "local_qps_spread": local_res.get("qps_spread"),
+            "local_p50_us": round(local_res["p50_us"], 1),
+            "local_p99_us": round(local_res["p99_us"], 1),
+            "failover_convergence_ms": round(convergence_ms, 1),
+            "passes": foreign["passes"],
+        }
     finally:
         for p in (local, remote):
             if p is not None:
@@ -1283,6 +1416,11 @@ async def _bench_realistic_async(tmpdir: str) -> Dict[str, object]:
                        "store": {"backend": "zookeeper",
                                  "host": "127.0.0.1", "port": zk_port},
                        "queryLog": True,
+                       # single-source load generator: lift the
+                       # per-client recursion rate limit (see
+                       # _bench_recursion)
+                       "admission": {"recursionRate": 1e9,
+                                     "recursionBurst": 1e9},
                        "recursion": {
                            "dcs": {"remotedc":
                                    [f"127.0.0.2:{rport}"]}}}, f)
@@ -2002,7 +2140,7 @@ def _try_axis(name: str, fn, retries: int = 1):
 def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
-    realistic = degraded = shard = zone_scale = None
+    realistic = degraded = shard = zone_scale = cross_dc = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -2029,6 +2167,8 @@ def run_bench() -> Dict[str, object]:
             shard = _try_axis("shard", lambda: _bench_shard(tmpdir))
             zone_scale = _try_axis("zone_scale",
                                    lambda: _bench_zone_scale(tmpdir))
+            cross_dc = _try_axis("cross_dc",
+                                 lambda: _bench_cross_dc(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -2251,6 +2391,12 @@ def run_bench() -> Dict[str, object]:
         # largest size within noise of the control, and the chunked
         # session rebuild's worst observed loop stall.
         out["zone_scale"] = zone_scale
+    if cross_dc is not None:
+        # cross_dc axis (ISSUE 11): foreign (registry-routed, forwarded
+        # to the owning DC) vs local p50/p99 through one federated
+        # binder, plus how long foreign names stay unanswered when the
+        # whole owning DC dies before the stale-serve path takes over
+        out["cross_dc"] = cross_dc
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm,
         # with the balancer's own per-stage attribution riding along

@@ -134,6 +134,7 @@ class Introspector:
             "tcp": self._tcp_section(),
             "inflight": self._inflight_section(),
             "recursion": self._recursion_section(),
+            "federation": self._federation_section(),
             "precompile": self._precompile_section(),
             "policy": self._policy_section(),
             "loop": (self.watchdog.snapshot()
@@ -253,6 +254,16 @@ class Introspector:
     def _recursion_section(self) -> Optional[dict]:
         rec = self.recursion
         return None if rec is None else rec.introspect()
+
+    def _federation_section(self) -> Optional[dict]:
+        """Multi-DC federation state (null when this binder is not
+        federated): DC registry membership, per-peer health, the
+        foreign-answer cache, and failover convergence — the "which
+        datacenter owns this name and is it alive" summary the
+        operations runbook keys on (docs/federation.md)."""
+        fed = getattr(self.server, "federation", None) \
+            if self.server is not None else None
+        return None if fed is None else fed.introspect()
 
     def _policy_section(self) -> Optional[dict]:
         """Degradation policy engine state (null when the whole layer

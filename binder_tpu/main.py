@@ -22,6 +22,7 @@ from binder_tpu.introspect import (BalancerStatsFold, FlightRecorder,
 from binder_tpu.metrics.collector import MetricsCollector, MetricsServer
 from binder_tpu.server import BinderServer
 from binder_tpu.store import FakeStore, MirrorCache
+from binder_tpu.utils import netif
 from binder_tpu.utils.jsonlog import log_event, make_logger
 
 NAME = "binder"
@@ -221,25 +222,58 @@ async def run(options: Dict[str, object]) -> BinderServer:
     cache = MirrorCache(store, str(options["dnsDomain"]), log=log,
                         collector=collector, recorder=recorder)
 
+    # multi-DC federation (binder_tpu/federation, docs/federation.md):
+    # peer discovery from the watched /dcs subtree, cross-DC forwarding
+    # through the recursion plane, foreign-answer stale-serve.  Started
+    # before the recursion client so its registry already holds the
+    # current membership when the routing table first fills.
+    federation = None
+    fed_cfg = options.get("federation")
+    if fed_cfg:
+        from binder_tpu.federation import Federation
+        federation = Federation(
+            store=store, dns_domain=str(options["dnsDomain"]),
+            datacenter_name=str(options.get("datacenterName", "")),
+            config=dict(fed_cfg), collector=collector,
+            recorder=recorder, log=log)
+        federation.start()
+
     recursion = None
-    if options.get("recursion"):
+    if options.get("recursion") or federation is not None:
         try:
             from binder_tpu.recursion import Recursion
         except ImportError as e:
             raise ConfigError(f"recursion unavailable: {e}")
-        rcfg = dict(options["recursion"])
+        rcfg = dict(options.get("recursion") or {})
+        # federation supplies the routing table from its /dcs registry
+        # unless the recursion block brings its own discovery (static
+        # dcs or UFDS).  Self-exclusion is then by DC name in the
+        # registry, not by NIC address — federated peers may share a
+        # host (one port per DC), which the NIC filter would wrongly
+        # drop; nicSelfFilter: true restores the address filter.
+        fed_source = None
+        if federation is not None and not (rcfg.get("dcs")
+                                           or rcfg.get("ufds")):
+            fed_source = federation.resolver_source()
         recursion = Recursion(
             zk_cache=cache, log=log,
             region_name=rcfg.get("regionName", ""),
             datacenter_name=str(options.get("datacenterName", "")),
             dns_domain=str(options["dnsDomain"]),
+            source=fed_source,
             # static per-DC resolver lists may live at recursion.dcs or
             # recursion.ufds.dcs; a real UFDS/LDAP source plugs in here
             ufds=rcfg.get("ufds") or rcfg,
+            nic_provider=((lambda: [])
+                          if fed_source is not None
+                          and not (fed_cfg or {}).get("nicSelfFilter")
+                          else netif.local_addresses),
             # per-peer circuit breakers report binder_breaker_state and
             # breaker-transition flight events (docs/degradation.md)
             collector=collector, recorder=recorder,
         )
+        if federation is not None:
+            federation.attach(recursion)
         await recursion.wait_ready()
 
     balancer_socket = (None if shard_worker is not None
@@ -293,6 +327,8 @@ async def run(options: Dict[str, object]) -> BinderServer:
         reuse_port=shard_worker is not None,
         announce=shard_worker is None,
     )
+    # introspection handle (/status federation section, bstat line)
+    server.federation = federation
     await server.start()
 
     if len(cache.nodes) > 100_000:

@@ -25,7 +25,19 @@ DEFAULT_TIMEOUT = 3.0  # lib/recursion.js:257
 
 
 class UpstreamError(Exception):
-    """No upstream produced a usable answer."""
+    """No upstream produced a usable answer.
+
+    ``got_response`` distinguishes *how* it failed: True means at least
+    one upstream returned a DNS response (an error rcode, truncation, a
+    malformed body — the peer is alive and said no); False means pure
+    transport failure (timeouts, socket death, all breakers open — the
+    peer may be dark).  The federation layer serves stale only on the
+    latter: a live peer's negative answer must stay a negative answer.
+    """
+
+    def __init__(self, msg: str = "", got_response: bool = False) -> None:
+        super().__init__(msg)
+        self.got_response = got_response
 
 
 def _parse_resolver(r: str) -> Tuple[str, int]:
@@ -176,6 +188,21 @@ class DnsClient:
         self._ports: dict = {}
         self._tmpl: dict = {}
         self._resolver_keys: dict = {}   # "ip:port" -> (host, port)
+        # single-flight: concurrent identical lookups collapse onto one
+        # upstream exchange (NXNSAttack posture: duplicate pressure must
+        # not multiply upstream work).  Keyed by the full lookup shape;
+        # the holder future fans the leader's outcome to followers.
+        self._inflight: dict = {}
+        self._qf_inflight: dict = {}     # (name, qtype, resolver) -> fut
+        self.coalesced = 0
+        # set by the owning Recursion: the labelled
+        # binder_recursion_coalesced_total child
+        self.m_coalesced = None
+
+    def _note_coalesced(self) -> None:
+        self.coalesced += 1
+        if self.m_coalesced is not None:
+            self.m_coalesced.inc()
 
     def _build_wire(self, name: str, qtype: int,
                     qid: int) -> Tuple[bytearray, int]:
@@ -271,6 +298,42 @@ class DnsClient:
                          resolvers: Sequence[str],
                          error_threshold: Optional[int] = None
                          ) -> bytes:
+        """Single-flight wrapper over :meth:`_lookup_raw_uncoalesced`:
+        concurrent identical lookups (same name, type, resolver set and
+        threshold) share ONE upstream exchange — the first caller runs
+        the real dispatch, everyone else awaits its outcome.  Failures
+        propagate to all waiters; a follower's cancellation never
+        cancels the leader's exchange (shield)."""
+        key = (name, qtype, tuple(resolvers), error_threshold)
+        holder = self._inflight.get(key)
+        if holder is not None and not holder.done():
+            self._note_coalesced()
+            return await asyncio.shield(holder)
+        loop = asyncio.get_running_loop()
+        holder = loop.create_future()
+        # followers may never materialize: retrieve the exception so an
+        # all-failed lookup with zero followers doesn't warn at GC
+        holder.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None)
+        self._inflight[key] = holder
+        try:
+            raw = await self._lookup_raw_uncoalesced(
+                name, qtype, resolvers, error_threshold)
+        except BaseException as e:
+            if not holder.done():
+                holder.set_exception(e)
+            raise
+        else:
+            if not holder.done():
+                holder.set_result(raw)
+            return raw
+        finally:
+            if self._inflight.get(key) is holder:
+                del self._inflight[key]
+
+    async def _lookup_raw_uncoalesced(
+            self, name: str, qtype: int, resolvers: Sequence[str],
+            error_threshold: Optional[int] = None) -> bytes:
         """Return the first NOERROR upstream response as validated raw
         wire bytes.
 
@@ -311,6 +374,7 @@ class DnsClient:
             return await self._lookup_one_raw(name, qtype, resolvers[0])
 
         errors: List[str] = []
+        alive = [False]     # any upstream returned a DNS response
         done_count = [0]
         started = [0]
         loop = asyncio.get_running_loop()
@@ -330,6 +394,7 @@ class DnsClient:
                     errors.append(f"{resolver}: {e}")
                     progress.set()
                 else:
+                    alive[0] = True
                     rcode = raw[3] & 0x0F
                     tc = bool(raw[2] & 0x02)
                     if rcode == Rcode.NOERROR and tc:
@@ -378,13 +443,14 @@ class DnsClient:
                         progress.set()
                 if len(errors) >= threshold and not winner.done():
                     winner.set_exception(UpstreamError(
-                        "; ".join(errors[-4:])))
+                        "; ".join(errors[-4:]), got_response=alive[0]))
             finally:
                 done_count[0] += 1
                 if (done_count[0] == len(resolvers)
                         and not winner.done()):
                     winner.set_exception(UpstreamError(
-                        "; ".join(errors[-4:]) or "all upstreams failed"))
+                        "; ".join(errors[-4:]) or "all upstreams failed",
+                        got_response=alive[0]))
 
         burst = min(self.concurrency, len(resolvers))
         tasks = [asyncio.ensure_future(one(r))
@@ -453,6 +519,18 @@ class DnsClient:
         if (e_loop is not loop or proto.transport is None
                 or proto.transport.is_closing()):
             return None
+        # single-flight on the zero-coroutine path too: a concurrent
+        # identical forward reuses the pending wire future — each
+        # caller's done-callback splices its own client id into the one
+        # shared upstream answer.  (Each completion also records the
+        # shared outcome on the breaker; N coalesced queries count as N
+        # observations of the same exchange, which slightly overweights
+        # it — harmless, and truthful about what clients experienced.)
+        qf_key = (name, qtype, resolver)
+        cur = self._qf_inflight.get(qf_key)
+        if cur is not None and not cur.done():
+            self._note_coalesced()
+            return cur
         qid = random.getrandbits(16)
         while qid in proto.pending:
             qid = random.getrandbits(16)
@@ -460,6 +538,11 @@ class DnsClient:
         fut: asyncio.Future = loop.create_future()
         proto.pending[qid] = (fut, bytes(wire[12:off + 5]),
                               loop.time() + self.timeout)
+        self._qf_inflight[qf_key] = fut
+        fut.add_done_callback(
+            lambda f, k=qf_key:
+            self._qf_inflight.pop(k)
+            if self._qf_inflight.get(k) is f else None)
         proto._arm_sweep(loop, min(self.timeout / 2, 0.25))
         proto.transport.sendto(wire)
         return fut
@@ -478,16 +561,21 @@ class DnsClient:
             try:
                 raw = await self._query_one_tcp(name, qtype, resolver)
             except Exception as e:  # noqa: BLE001
-                raise UpstreamError(f"{resolver}: tcp retry: {e}")
+                # the UDP response arrived: the peer is alive even
+                # though its TCP retry failed
+                raise UpstreamError(f"{resolver}: tcp retry: {e}",
+                                    got_response=True)
             rcode = raw[3] & 0x0F
             tc = bool(raw[2] & 0x02)
         if rcode == Rcode.NOERROR and not tc:
             if wire_walks(raw):
                 return raw
-            raise UpstreamError(f"{resolver}: malformed body")
+            raise UpstreamError(f"{resolver}: malformed body",
+                                got_response=True)
         raise UpstreamError(
             f"{resolver}: "
-            + ("truncated" if tc else f"rcode {Rcode.name(rcode)}"))
+            + ("truncated" if tc else f"rcode {Rcode.name(rcode)}"),
+            got_response=True)
 
     async def _query_one(self, name: str, qtype: int,
                          resolver: str) -> bytes:

@@ -140,6 +140,22 @@ class Recursion:
         for c in (self.nsc, self.nsc_max):
             if c.breakers is None:
                 c.breakers = breakers
+        if collector is not None:
+            m = collector.counter(
+                "binder_recursion_coalesced_total",
+                "concurrent identical recursions collapsed onto one "
+                "upstream exchange (single-flight)").labelled()
+            m.inc(0)
+            for c in (self.nsc, self.nsc_max):
+                if c.m_coalesced is None:
+                    c.m_coalesced = m
+
+        # federation layer (binder_tpu/federation): set via
+        # Federation.attach().  upstream_budget is the per-query
+        # upstream-work ceiling (NXNSAttack, arXiv:2005.09107) applied
+        # to the slow path's fan-out list; None = unbounded (classic).
+        self.federation = None
+        self.upstream_budget: Optional[int] = None
 
         self.dcs: Dict[str, List[str]] = {}
         # monotonic instant of the last successful resolver-discovery
@@ -257,6 +273,9 @@ class Recursion:
             # 0x20-incompatible peer
             "case_mismatch_drops": (self.nsc.case_mismatch_drops()
                                     + self.nsc_max.case_mismatch_drops()),
+            # concurrent identical lookups collapsed by single-flight
+            "coalesced": self.nsc.coalesced + self.nsc_max.coalesced,
+            "upstream_budget": self.upstream_budget,
             # per-peer circuit breakers (docs/degradation.md): state,
             # failure runs, backoff, and the p95 behind the hedge delay
             "breakers": self.breakers.introspect(),
@@ -301,6 +320,8 @@ class Recursion:
                     fut = self.nsc.query_future(domain, query.qtype(),
                                                 ups[0])
                     if fut is not None:
+                        if self.federation is not None:
+                            self.federation.note_forward(domain)
                         # attribution: "dispatch" = local work between
                         # the mirror miss and the upstream send
                         query.stamp("dispatch")
@@ -345,6 +366,14 @@ class Recursion:
                     upstream, raw_up is not None,
                     None if recv_t is None or sent_at is None
                     else recv_t - sent_at)
+            if raw_up is None and self.federation is not None:
+                # transport-level failure (timeout / socket death), not
+                # a negative answer: the owning DC may be dark — serve
+                # the cached foreign answer per the degradation policy
+                if self.federation.serve_dark(query, domain):
+                    if self.engine_after is not None:
+                        self.engine_after(query)
+                    return
             if raw_up is not None:
                 rcode = raw_up[3] & 0x0F
                 if raw_up[2] & 0x02 and rcode == Rcode.NOERROR:
@@ -353,6 +382,10 @@ class Recursion:
                     self._spawn(self._finish_tcp(query, domain))
                     return
                 if rcode != Rcode.NOERROR:
+                    if self.federation is not None:
+                        # a negative answer is still a LIVE peer
+                        self.federation.note_success(
+                            domain, query.qtype(), raw_up)
                     raw_up = None       # REFUSED shape below
             self._finish_wire(query, domain, raw_up)
         except Exception:  # noqa: BLE001 — callback context: must not leak
@@ -387,6 +420,10 @@ class Recursion:
                      raw_up: Optional[bytes]) -> None:
         """Shared tail: splice / rebuild / REFUSED, then the after hook."""
         answers: List[Record] = []
+        if raw_up is not None and self.federation is not None:
+            # the DC answered: mark it alive and deposit the answer in
+            # the foreign cache (the dark-serve fallback's inventory)
+            self.federation.note_success(domain, query.qtype(), raw_up)
         if raw_up is not None:
             if self._try_splice(query, raw_up):
                 if self.engine_after is not None:
@@ -446,9 +483,22 @@ class Recursion:
             self._respond_rebuilt(query, domain, answers)
             return
 
+        # per-query upstream-work budget (NXNSAttack, arXiv:2005.09107):
+        # one client query may touch at most this many upstreams — the
+        # PTR fan-out across every DC is exactly the amplification shape
+        # the budget exists to cap
+        budget = self.upstream_budget
+        if budget is not None and len(upstreams) > budget:
+            upstreams = upstreams[:budget]
+            query.log_ctx["budget_clamped"] = True
+            if self.federation is not None:
+                self.federation.m_budget.inc()
+
         nsc = self.nsc_max if is_ptr else self.nsc
         raw_up = None
         query.stamp("dispatch")
+        if self.federation is not None and not is_ptr:
+            self.federation.note_forward(domain)
         try:
             raw_up = await nsc.lookup_raw(
                 domain, query.qtype(), upstreams,
@@ -456,8 +506,16 @@ class Recursion:
             # whole awaited lookup (RTT + loop scheduling + any retries)
             # — the slow path can't split them like the future fast path
             query.stamp("upstream")
+            if self.federation is not None and not is_ptr:
+                self.federation.note_success(domain, query.qtype(), raw_up)
         except UpstreamError as e:
             self.log.debug("recursion upstream error: %s", e)
+            if (self.federation is not None and not is_ptr
+                    and not e.got_response
+                    and self.federation.serve_dark(query, domain)):
+                # transport-dark DC: stale-served (or withheld) from
+                # the foreign cache — never a timeout
+                return
         if raw_up is not None:
             # Raw splice (the hot path): the upstream answer — already
             # validated by id + dns0x20 question echo + NOERROR — is

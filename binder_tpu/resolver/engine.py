@@ -42,6 +42,7 @@ from binder_tpu.dns.wire import (
     SOARecord,
     SRVRecord,
     Type,
+    ip_from_reverse_name,
 )
 from binder_tpu.store.cache import MirrorCache
 from binder_tpu.store.names import rec_parts as _rec_parts
@@ -446,7 +447,11 @@ class Resolver:
             if adm is not None and not adm.allow_recursion(query.src[0]):
                 # recursion-triggering floods are shed per client
                 # BEFORE any upstream work (docs/degradation.md):
-                # well-formed REFUSED, clients fail over
+                # well-formed REFUSED, clients fail over.  The shed is
+                # a PER-CLIENT transient — it must never enter the
+                # shared answer cache, or one client's flood poisons
+                # the name with REFUSED for everyone until expiry
+                query.no_store = True
                 query.set_error(Rcode.REFUSED)
                 query.log_ctx["reason"] = "recursion rate limit"
                 query.stamp("pre-resp")
@@ -482,15 +487,25 @@ class Resolver:
         """Pure resolution of a PTR question against the reverse map."""
         p = AnswerPlan()
         parts = list(reversed(qname.split(".")))
-        if len(parts) < 2 or parts[0] != "arpa" or parts[1] != "in-addr":
-            # v6 reverse names included: the reference only serves IPv4 PTR
+        if len(parts) >= 2 and parts[0] == "arpa" and parts[1] == "ip6":
+            # IPv6 reverse: strict canonical nibble parse — the reverse
+            # map is keyed by the canonical address string, and a
+            # malformed ip6.arpa name simply misses (REFUSED below)
+            ip = ip_from_reverse_name(qname.lower())
+            if ip is None:
+                p.reason = "not a valid ip6 reverse name"
+                p.rcode = Rcode.REFUSED
+                return p
+        elif (len(parts) < 2 or parts[0] != "arpa"
+                or parts[1] != "in-addr"):
             p.reason = "not an ipv4 reverse name"
             p.rcode = Rcode.REFUSED
             return p
-        # No octet validation: an invalid address simply misses the cache
-        # and is REFUSED, so the client tries its next NS
-        # (comment at lib/server.js:79-83)
-        ip = ".".join(parts[2:])
+        else:
+            # No octet validation: an invalid address simply misses the
+            # cache and is REFUSED, so the client tries its next NS
+            # (comment at lib/server.js:79-83)
+            ip = ".".join(parts[2:])
 
         if not self.cache.is_ready():
             self.log.error("no coordination-store session")

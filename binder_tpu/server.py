@@ -39,7 +39,9 @@ from binder_tpu.dns.wire import (
     Type,
     WireError,
     encode_name,
+    ip_from_reverse_name,
     patch_answer_wire,
+    reverse_name_for_ip,
 )
 from binder_tpu.metrics.collector import (
     DEFAULT_SIZE_BUCKETS,
@@ -283,6 +285,10 @@ class BinderServer:
             # arm the recursion fast path: its future callback completes
             # the query AND runs the engine's after hook itself
             recursion.engine_after = self._engine_after_hook
+        # multi-DC federation handle (binder_tpu/federation) — set by
+        # main.py (or tests) after construction; read by the
+        # introspector for the /status federation section
+        self.federation = None
 
         # Mutation-time answer precompilation (resolver/precompile.py):
         # store mutations eagerly re-render the affected names' answers
@@ -586,7 +592,7 @@ class BinderServer:
         pending = self.resolver.handle(query)
 
         if (pending is None and key is not None and query.responded
-                and query.wire is not None
+                and query.wire is not None and not query.no_store
                 and query.rcode() != Rcode.SERVFAIL):
             ans = [self._summarize(r) for r in query.response.answers]
             add = [self._summarize(r) for r in query.response.additionals
@@ -811,11 +817,18 @@ class BinderServer:
         or ineligible names simply stay un-pushed and resolve through
         the raw lane / generic path."""
         try:
-            if name.endswith(".in-addr.arpa"):
-                parts = name.split(".")
-                if len(parts) < 3:
-                    return
-                ip = ".".join(reversed(parts[:-2]))
+            if name.endswith(".in-addr.arpa") or name.endswith(".ip6.arpa"):
+                if name.endswith(".ip6.arpa"):
+                    # v6 reverse: canonical nibble parse; the PTR body
+                    # is address-family-agnostic once the owner is found
+                    ip = ip_from_reverse_name(name)
+                    if ip is None:
+                        return
+                else:
+                    parts = name.split(".")
+                    if len(parts) < 3:
+                        return
+                    ip = ".".join(reversed(parts[:-2]))
                 owner = self.zk_cache.reverse_lookup(ip)
                 if owner is not None:
                     self._zone_push_ptr(name, owner)
@@ -1264,6 +1277,15 @@ class BinderServer:
         self._zone_refresh(domain)
         ip = node.ip
         if ip and type(ip) is str:
+            if ":" in ip:
+                # v6 (already canonical via TreeNode.ip): precompile
+                # the ip6.arpa PTR alongside the forward name
+                try:
+                    rev = reverse_name_for_ip(ip)
+                except ValueError:
+                    return
+                self._zone_refresh(rev)
+                return
             parts = ip.split(".")
             if len(parts) == 4 and all(p.isdigit() for p in parts):
                 self._zone_refresh(
@@ -1609,17 +1631,27 @@ class BinderServer:
             # NO dnsDomain suffix policy on the reverse tree
             # (lib/server.js:67-134)
             rcode = 0
+            ip = None
             parts = name.split(".")
-            if len(parts) < 2 or parts[-1] != "arpa" \
+            if len(parts) >= 2 and parts[-1] == "arpa" \
+                    and parts[-2] == "ip6":
+                # IPv6 reverse: strict canonical nibble parse (the
+                # reverse map is keyed by canonical address strings);
+                # malformed ip6.arpa names miss below
+                ip = ip_from_reverse_name(name)
+                if ip is None:
+                    rcode = Rcode.REFUSED
+            elif len(parts) < 2 or parts[-1] != "arpa" \
                     or parts[-2] != "in-addr":
-                rcode = Rcode.REFUSED  # not an ipv4 reverse name
-            elif not cache.is_ready():
+                rcode = Rcode.REFUSED  # not an ip reverse name
+            if rcode == 0 and not cache.is_ready():
                 self.log.error("no coordination-store session")
                 rcode = Rcode.SERVFAIL
-            else:
-                # no octet validation: an invalid address simply misses
-                # (comment at lib/server.js:79-83)
-                ip = ".".join(reversed(parts[:-2]))
+            elif rcode == 0:
+                if ip is None:
+                    # no octet validation: an invalid address simply
+                    # misses (comment at lib/server.js:79-83)
+                    ip = ".".join(reversed(parts[:-2]))
                 node = cache.reverse_lookup(ip)
                 if node is None:
                     if self.resolver.recursion is not None and rd_flag:

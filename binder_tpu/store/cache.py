@@ -38,6 +38,7 @@ existing nodes while the walk re-registers watchers underneath it.
 from __future__ import annotations
 
 import asyncio
+import ipaddress
 import json
 import logging
 import time
@@ -60,15 +61,21 @@ def domain_to_path(domain: str) -> str:
 
 
 def _rev_name(ip: Optional[str]) -> Optional[str]:
-    """'10.1.2.3' -> '3.2.1.10.in-addr.arpa' (the PTR qname an answer
-    for this address is cached under); None for non-IPv4 strings —
-    reverse resolution is IPv4-only (engine.resolve_ptr, matching the
-    reference lib/server.js:71-84).  No canonicalization: the engine
-    does not validate octets either, so a non-canonical stored address
-    ('10.1.2.03') pairs with exactly the reverse qname a client would
-    use to reach it."""
+    """'10.1.2.3' -> '3.2.1.10.in-addr.arpa', '2001:db8::1' ->
+    '...ip6.arpa' (the PTR qname an answer for this address is cached
+    under); None for strings that are neither.  For IPv4, no
+    canonicalization: the engine does not validate octets either, so a
+    non-canonical stored address ('10.1.2.03') pairs with exactly the
+    reverse qname a client would use to reach it.  IPv6 addresses are
+    canonical by the time they reach here (``TreeNode.ip`` normalizes),
+    matching ``wire.ip_from_reverse_name``'s canonical output."""
     if not ip:
         return None
+    if ":" in ip:
+        try:
+            return ipaddress.IPv6Address(ip).reverse_pointer
+        except (ValueError, ipaddress.AddressValueError):
+            return None
     parts = ip.split(".")
     if len(parts) != 4 or not all(p.isdigit() for p in parts):
         return None
@@ -134,15 +141,24 @@ class TreeNode:
         derived from the record (was a stored slot; at a million
         names every slot counts)."""
         rec = self._rec
+        addr = None
         if type(rec) is tuple:
-            return rec[1] if rec[0] in HOST_TYPES else None
-        if isinstance(rec, dict):
+            addr = rec[1] if rec[0] in HOST_TYPES else None
+        elif isinstance(rec, dict):
             rtype = rec.get("type")
             if isinstance(rtype, str) and rtype in HOST_TYPES:
                 sub = rec.get(rtype)
                 if isinstance(sub, dict):
-                    return sub.get("address")
-        return None
+                    addr = sub.get("address")
+        if addr and ":" in addr:
+            # IPv6: the reverse map is keyed by canonical form so a
+            # stored "2001:DB8:0::1" meets the canonical string
+            # ip_from_reverse_name derives from an ip6.arpa qname
+            try:
+                return str(ipaddress.IPv6Address(addr))
+            except (ValueError, ipaddress.AddressValueError):
+                return None
+        return addr
 
     def _kid_node(self, label: str) -> Optional["TreeNode"]:
         return self.cache.nodes.get((label + "." + self.domain).lower())

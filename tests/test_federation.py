@@ -1,0 +1,621 @@
+"""Multi-DC federation tests (ISSUE 11): the watched ``/dcs`` registry,
+cross-DC forwarding through the registry-fed routing table, the
+foreign-answer cache's stale-serve/withhold policy for dark DCs, the
+per-query upstream budget, and the ``binder_federation_*`` metric pins.
+
+The wire-outcome matrix this suite pins (docs/federation.md):
+
+    foreign name, owning DC live, name unknown    -> REFUSED
+    foreign name, owning DC dark, cached answer   -> NOERROR, TTL clamped
+    foreign name, owning DC dark, past cap        -> SERVFAIL (withheld)
+    foreign name, owning DC dark, nothing cached  -> REFUSED
+    local name                                    -> unaffected by any of it
+
+A dark DC is a transport-level fact (timeout, socket death): a live
+peer answering NXDOMAIN/REFUSED stays an ordinary negative answer.
+"""
+import asyncio
+
+from binder_tpu.dns import Message, Rcode, Type
+from binder_tpu.federation import DcRegistry, Federation
+from binder_tpu.metrics.collector import MetricsCollector
+from binder_tpu.recursion import DnsClient, Recursion
+from binder_tpu.server import BinderServer
+from binder_tpu.store import FakeStore, MirrorCache
+
+from tests.test_recursion import (
+    make_remote_fixture,
+    start_remote,
+    udp_ask,
+    udp_ask_wire,
+)
+from tools.lint import validate_federation_metrics
+
+DOMAIN = "foo.com"
+
+
+async def start_federated(remotes, fed_cfg=None, server_kw=None, **rkw):
+    """Local binder whose routing table comes from the watched ``/dcs``
+    subtree of its own store.  ``remotes`` maps dc name -> peer list;
+    each becomes a ``/dcs/<dc>`` record before the session starts."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    # the local DC's own names, served straight from the mirror
+    store.put_json("/com/foo/local", {"type": "service",
+                                      "service": {"port": 53}})
+    store.put_json("/com/foo/local/web",
+                   {"type": "host", "host": {"address": "10.1.0.1",
+                                             "ttl": 30}})
+    for dc, peers in remotes.items():
+        store.put_json(f"/dcs/{dc}", {"zones": [dc], "peers": peers})
+    store.start_session()
+    collector = MetricsCollector()
+    federation = Federation(store=store, dns_domain=DOMAIN,
+                            datacenter_name="local",
+                            config=fed_cfg, collector=collector)
+    federation.start()
+    recursion = Recursion(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="local",
+        source=federation.resolver_source(),
+        nic_provider=lambda: [],  # tests use 127.0.0.1 resolvers
+        collector=collector, **rkw)
+    federation.attach(recursion)
+    await recursion.wait_ready()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="local", recursion=recursion,
+                          host="127.0.0.1", port=0, collector=collector,
+                          **(server_kw or {}))
+    server.federation = federation
+    await server.start()
+    return server, recursion, federation
+
+
+def fast_client():
+    """Short-timeout client so dark-DC tests pay ~0.3s, not 3s."""
+    return DnsClient(concurrency=2, timeout=0.3)
+
+
+class TestDcRegistry:
+    def test_join_leave_and_data_change(self):
+        store = FakeStore()
+        store.put_json("/dcs/east", {"zones": ["east"],
+                                     "peers": ["10.0.0.1:53"]})
+        store.start_session()
+        reg = DcRegistry(store, self_name="local")
+        reg.start()
+        assert set(reg.records) == {"east"}
+        assert reg.foreign_zone_map() == {"east": ["10.0.0.1:53"]}
+        assert reg.joins == 1
+
+        # a DC joining is just a mutation under /dcs
+        store.put_json("/dcs/west", {"zones": ["west", "w2"],
+                                     "peers": ["10.0.0.2:53"]})
+        assert reg.zone_owner("w2") == "west"
+        assert reg.joins == 2
+
+        # a peer-set change propagates through the data watcher
+        store.put_json("/dcs/east", {"zones": ["east"],
+                                     "peers": ["10.0.0.9:53"]})
+        assert reg.foreign_zone_map()["east"] == ["10.0.0.9:53"]
+        assert reg.joins == 2  # an update, not a re-join
+
+        # a DC leaving is a child deletion
+        store.delete("/dcs/west")
+        assert set(reg.records) == {"east"}
+        assert reg.leaves == 1
+
+    def test_self_excluded_from_routing(self):
+        store = FakeStore()
+        store.put_json("/dcs/local", {"zones": ["local"],
+                                      "peers": ["10.0.0.1:53"]})
+        store.put_json("/dcs/east", {"zones": ["east"],
+                                     "peers": ["10.0.0.2:53"]})
+        store.start_session()
+        reg = DcRegistry(store, self_name="local")
+        reg.start()
+        assert set(reg.records) == {"local", "east"}
+        assert reg.foreign_zone_map() == {"east": ["10.0.0.2:53"]}
+        assert reg.zone_owner("local") is None
+
+    def test_malformed_record_drops_dc(self):
+        store = FakeStore()
+        store.put_json("/dcs/east", {"zones": ["east"],
+                                     "peers": ["10.0.0.1:53"]})
+        store.start_session()
+        reg = DcRegistry(store, self_name="local")
+        reg.start()
+        assert "east" in reg.records
+        # garbage record: routing on stale peers would be worse than
+        # not knowing the DC at all
+        store.set_data("/dcs/east", b"not json")
+        assert "east" not in reg.records
+
+    def test_static_bootstrap(self):
+        # shard ReplicaStore workers: the mutation log doesn't carry
+        # /dcs, so the supervisor-passed config seeds the map
+        store = FakeStore()
+        store.start_session()
+        reg = DcRegistry(store, self_name="local", static_records=[
+            {"name": "east", "zones": ["east"], "peers": ["10.0.0.1:53"]},
+        ])
+        reg.start()
+        assert reg.foreign_zone_map() == {"east": ["10.0.0.1:53"]}
+
+    def test_dcs_created_after_start(self):
+        # mkdirp fires the parent's children watcher at each created
+        # level, so a /dcs subtree born after start() still lands
+        store = FakeStore()
+        store.start_session()
+        reg = DcRegistry(store, self_name="local")
+        reg.start()
+        assert reg.records == {}
+        store.put_json("/dcs/east", {"zones": ["east"],
+                                     "peers": ["10.0.0.1:53"]})
+        assert "east" in reg.records
+
+
+class TestFederatedForwarding:
+    def test_foreign_name_resolves_through_registry(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.1")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            try:
+                r = await udp_ask(server.udp_port, "web.east.foo.com",
+                                  Type.A)
+                local = await udp_ask(server.udp_port,
+                                      "web.local.foo.com", Type.A)
+                return r, local, federation.forwards
+            finally:
+                await server.stop()
+                await recursion.close()
+                await remote.stop()
+
+        r, local, forwards = asyncio.run(run())
+        assert r.rcode == Rcode.NOERROR
+        assert r.answers[0].address == "10.77.0.1"
+        assert r.answers[0].ttl == 44
+        assert local.rcode == Rcode.NOERROR
+        assert local.answers[0].address == "10.1.0.1"
+        assert forwards >= 1
+
+    def test_cross_dc_parity_with_direct_modulo_id(self):
+        """The federated binder's forwarded answer must be byte-equal
+        with the owning DC's direct render, modulo the id bytes and the
+        RA bit (the forwarding binder IS a recursive service; the
+        owning DC is not)."""
+        async def run():
+            remote = await start_remote("east", "10.77.0.5")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            try:
+                direct = await udp_ask_wire(remote.udp_port,
+                                            "web.east.foo.com", Type.A)
+                fwd = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+            finally:
+                await server.stop()
+                await recursion.close()
+                await remote.stop()
+            return direct, fwd
+
+        direct, fwd = asyncio.run(run())
+        a, b = bytearray(direct), bytearray(fwd)
+        assert b[3] & 0x80, "forwarded answer must set RA"
+        a[3] |= 0x80  # mask the RA difference
+        assert a[2:] == b[2:], "cross-DC answer diverged from direct"
+
+    def test_membership_change_updates_routing(self):
+        async def run():
+            r1 = await start_remote("east", "10.77.0.1")
+            r2 = await start_remote("west", "10.88.0.1")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{r1.udp_port}"]})
+            try:
+                miss = await udp_ask(server.udp_port, "web.west.foo.com",
+                                     Type.A)
+                # west joins: one mutation under /dcs, no restart
+                federation.registry.store.put_json(
+                    "/dcs/west", {"zones": ["west"],
+                                  "peers": [f"127.0.0.1:{r2.udp_port}"]})
+                for _ in range(20):
+                    if "west" in recursion.dcs:
+                        break
+                    await asyncio.sleep(0.02)
+                hit = await udp_ask(server.udp_port, "web.west.foo.com",
+                                    Type.A)
+                return miss, hit
+            finally:
+                await server.stop()
+                await recursion.close()
+                await r1.stop()
+                await r2.stop()
+
+        miss, hit = asyncio.run(run())
+        assert miss.rcode == Rcode.REFUSED
+        assert hit.rcode == Rcode.NOERROR
+        assert hit.answers[0].address == "10.88.0.1"
+
+
+class TestShedNotCached:
+    def test_rate_limit_refused_never_enters_answer_cache(self):
+        """An admission shed is a PER-CLIENT transient: the synchronous
+        REFUSED it produces must never be deposited in the shared
+        answer cache, or one client's flood poisons the name with
+        REFUSED for every other client until expiry (regression: found
+        by the cross_dc bench axis, where the load generator's own
+        sheds made foreign names unresolvable after the flood ended)."""
+        async def run():
+            remote = await start_remote("east", "10.77.0.9")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                server_kw={"admission": {"recursionRate": 0.001,
+                                         "recursionBurst": 2.0}})
+            try:
+                shed = None
+                for _ in range(8):
+                    r = await udp_ask(server.udp_port,
+                                      "web.east.foo.com", Type.A)
+                    if r.rcode == Rcode.REFUSED:
+                        shed = r
+                        break
+                # an evicted (or simply different) client starts with a
+                # full bucket — clearing the table models "another
+                # client asks the same name after the flood"
+                server._admission._buckets.clear()
+                after = await udp_ask(server.udp_port,
+                                      "web.east.foo.com", Type.A)
+                return shed, after
+            finally:
+                await server.stop()
+                await recursion.close()
+                await remote.stop()
+
+        shed, after = asyncio.run(run())
+        assert shed is not None, "flood never tripped the rate limit"
+        assert after.rcode == Rcode.NOERROR, \
+            "shed REFUSED leaked into the shared answer cache"
+        assert after.answers[0].address == "10.77.0.9"
+
+
+class TestDarkDcPolicy:
+    def test_stale_served_with_clamped_ttl(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.2")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                fed_cfg={"staleTtlClampSeconds": 5},
+                client=fast_client())
+            try:
+                warm = await udp_ask(server.udp_port, "web.east.foo.com",
+                                     Type.A)
+                await remote.stop()  # the whole DC goes dark
+                stale = await udp_ask(server.udp_port,
+                                      "web.east.foo.com", Type.A)
+                local = await udp_ask(server.udp_port,
+                                      "web.local.foo.com", Type.A)
+                return warm, stale, local, federation
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        warm, stale, local, federation = asyncio.run(run())
+        assert warm.rcode == Rcode.NOERROR and warm.answers[0].ttl == 44
+        assert stale.rcode == Rcode.NOERROR
+        assert stale.answers[0].address == "10.77.0.2"
+        assert stale.answers[0].ttl == 5, "stale answer must clamp TTL"
+        # local serving is untouched by a foreign DC's darkness
+        assert local.rcode == Rcode.NOERROR and local.answers[0].ttl == 30
+        assert federation.dark_dcs() == ["east"]
+        assert federation.last_convergence_s is not None
+
+    def test_withheld_past_staleness_cap(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.3")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                fed_cfg={"maxStalenessSeconds": 0.0},
+                client=fast_client())
+            try:
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                await remote.stop()
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+                return raw
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        raw = asyncio.run(run())
+        # withheld: a well-formed SERVFAIL, never a timeout
+        assert raw[3] & 0x0F == Rcode.SERVFAIL
+
+    def test_withheld_refused_action(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.4")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                fed_cfg={"maxStalenessSeconds": 0.0,
+                         "exhaustedAction": "refused"},
+                client=fast_client())
+            try:
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                await remote.stop()
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+                return raw
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        assert asyncio.run(run())[3] & 0x0F == Rcode.REFUSED
+
+    def test_dark_with_nothing_cached_refused(self):
+        async def run():
+            server, recursion, federation = await start_federated(
+                {"east": ["127.0.0.1:9"]},  # discard port: dark from birth
+                client=fast_client())
+            try:
+                raw = await udp_ask_wire(server.udp_port,
+                                         "web.east.foo.com", Type.A)
+                return raw, federation
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        raw, federation = asyncio.run(run())
+        assert raw[3] & 0x0F == Rcode.REFUSED
+        assert federation.dark_dcs() == ["east"]
+
+    def test_live_negative_is_not_dark(self):
+        """A peer answering REFUSED is alive: no dark transition, no
+        stale-serve — foreign NXDOMAIN-ish outcomes stay negative."""
+        async def run():
+            remote = await start_remote("east", "10.77.0.1")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]})
+            try:
+                r = await udp_ask(server.udp_port, "nope.east.foo.com",
+                                  Type.A)
+                return r, federation
+            finally:
+                await server.stop()
+                await recursion.close()
+                await remote.stop()
+
+        r, federation = asyncio.run(run())
+        assert r.rcode == Rcode.REFUSED
+        assert federation.dark_dcs() == []
+
+    def test_recovery_after_dark(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.6")
+            port = remote.udp_port
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{port}"]},
+                client=fast_client())
+            try:
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                await remote.stop()
+                stale = await udp_ask(server.udp_port,
+                                      "web.east.foo.com", Type.A)
+                assert stale.answers, "expected a stale-served answer"
+                assert federation.dark_dcs() == ["east"]
+                # the DC comes back on the same address
+                remote2 = BinderServer(
+                    zk_cache=make_remote_fixture("east", "10.77.0.6"),
+                    dns_domain=DOMAIN, datacenter_name="east",
+                    host="127.0.0.1", port=port,
+                    collector=MetricsCollector())
+                await remote2.start()
+                try:
+                    # breakers half-open after backoff; poll until the
+                    # forward path proves the peer alive again
+                    for _ in range(80):
+                        await udp_ask(server.udp_port, "web.east.foo.com",
+                                      Type.A, timeout=5.0)
+                        if not federation.dark_dcs():
+                            break
+                        await asyncio.sleep(0.1)
+                    return federation.dark_dcs()
+                finally:
+                    await remote2.stop()
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        assert asyncio.run(run()) == []
+
+
+class TestUpstreamBudget:
+    def test_ptr_fanout_clamped(self):
+        async def run():
+            r1 = await start_remote("east", "10.77.0.1")
+            r2 = await start_remote("west", "10.88.0.1")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{r1.udp_port}"],
+                 "west": [f"127.0.0.1:{r2.udp_port}"]},
+                fed_cfg={"upstreamBudget": 1})
+            try:
+                assert recursion.upstream_budget == 1
+                await udp_ask(server.udp_port, "1.0.88.10.in-addr.arpa",
+                              Type.PTR)
+                clamps = server.collector.get(
+                    "binder_federation_budget_clamped_total").total()
+                return clamps
+            finally:
+                await server.stop()
+                await recursion.close()
+                await r1.stop()
+                await r2.stop()
+
+        # the 2-upstream PTR fan-out was clamped to 1
+        assert asyncio.run(run()) >= 1
+
+    def test_unbounded_by_default_outside_federation(self):
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        store.start_session()
+        rec = Recursion(zk_cache=cache, dns_domain=DOMAIN,
+                        datacenter_name="local")
+        assert rec.upstream_budget is None
+
+
+def _echo_question(data: bytes) -> bytes:
+    """Empty NOERROR response echoing the query's question verbatim
+    (dns0x20: the client validates the exact case mask it sent)."""
+    q = Message.decode(data)
+    resp = bytearray(Message(id=q.id, qr=True,
+                             questions=list(q.questions)).encode())
+    off = 12
+    while data[off] != 0:
+        off += 1 + data[off]
+    qlen = off + 5 - 12
+    resp[12:12 + qlen] = data[12:12 + qlen]
+    return bytes(resp)
+
+
+class TestSingleFlight:
+    def test_identical_lookups_coalesced(self):
+        async def run():
+            loop = asyncio.get_running_loop()
+
+            class SlowUpstream(asyncio.DatagramProtocol):
+                hits = 0
+
+                def connection_made(self, transport):
+                    self.transport = transport
+
+                def datagram_received(self, data, addr):
+                    type(self).hits += 1
+
+                    def reply():
+                        self.transport.sendto(
+                            _echo_question(data), addr)
+
+                    loop.call_later(0.15, reply)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                SlowUpstream, local_addr=("127.0.0.1", 0))
+            port = tr.get_extra_info("sockname")[1]
+            client = DnsClient(concurrency=2, timeout=2.0)
+            try:
+                outs = await asyncio.gather(*[
+                    client.lookup_raw("x.foo.com", Type.A,
+                                      [f"127.0.0.1:{port}"])
+                    for _ in range(5)])
+            finally:
+                client.close()
+                tr.close()
+            return outs, client.coalesced, SlowUpstream.hits
+
+        outs, coalesced, hits = asyncio.run(run())
+        assert len(outs) == 5 and all(o == outs[0] for o in outs)
+        assert coalesced == 4, "4 of 5 identical lookups must coalesce"
+        assert hits == 1, "one upstream exchange for 5 callers"
+
+    def test_different_names_not_coalesced(self):
+        async def run():
+            loop = asyncio.get_running_loop()
+
+            class Upstream(asyncio.DatagramProtocol):
+                def connection_made(self, transport):
+                    self.transport = transport
+
+                def datagram_received(self, data, addr):
+                    self.transport.sendto(_echo_question(data), addr)
+
+            tr, _ = await loop.create_datagram_endpoint(
+                Upstream, local_addr=("127.0.0.1", 0))
+            port = tr.get_extra_info("sockname")[1]
+            client = DnsClient(concurrency=2, timeout=2.0)
+            try:
+                await asyncio.gather(*[
+                    client.lookup_raw(f"x{i}.foo.com", Type.A,
+                                      [f"127.0.0.1:{port}"])
+                    for i in range(3)])
+            finally:
+                client.close()
+                tr.close()
+            return client.coalesced
+
+        assert asyncio.run(run()) == 0
+
+
+class TestFederationObservability:
+    def test_metrics_validate_and_status_section(self):
+        async def run():
+            remote = await start_remote("east", "10.77.0.7")
+            server, recursion, federation = await start_federated(
+                {"east": [f"127.0.0.1:{remote.udp_port}"]},
+                client=fast_client())
+            try:
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                await remote.stop()
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                text = server.collector.expose()
+
+                from binder_tpu.introspect import Introspector
+                snap = Introspector(server=server).snapshot()
+                return text, snap
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        text, snap = asyncio.run(run())
+        assert validate_federation_metrics(text) == [], \
+            validate_federation_metrics(text)
+        # a forward to east was dispatched and counted per-DC
+        assert 'binder_federation_forwards_total{dc="east"}' in text
+
+        fed = snap["federation"]
+        assert fed is not None
+        assert fed["datacenter"] == "local"
+        assert "east" in fed["registry"]["dcs"]
+        assert fed["dark"] == ["east"]
+        assert fed["forwards"] >= 2
+        assert fed["foreign_cache"]["entries"] >= 1
+        assert fed["last_convergence_seconds"] is not None
+
+    def test_flight_events_on_membership_and_failover(self):
+        async def run():
+            from binder_tpu.introspect import FlightRecorder
+            recorder = FlightRecorder()
+            remote = await start_remote("east", "10.77.0.8")
+            store = FakeStore()
+            cache = MirrorCache(store, DOMAIN)
+            store.put_json("/dcs/east",
+                           {"zones": ["east"],
+                            "peers": [f"127.0.0.1:{remote.udp_port}"]})
+            store.start_session()
+            federation = Federation(store=store, dns_domain=DOMAIN,
+                                    datacenter_name="local",
+                                    recorder=recorder)
+            federation.start()
+            recursion = Recursion(
+                zk_cache=cache, dns_domain=DOMAIN,
+                datacenter_name="local",
+                source=federation.resolver_source(),
+                nic_provider=lambda: [], client=fast_client())
+            federation.attach(recursion)
+            await recursion.wait_ready()
+            server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                                  datacenter_name="local",
+                                  recursion=recursion, host="127.0.0.1",
+                                  port=0, collector=MetricsCollector())
+            server.federation = federation
+            await server.start()
+            try:
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                await remote.stop()
+                await udp_ask(server.udp_port, "web.east.foo.com", Type.A)
+                store.delete("/dcs/east")
+                return [e["type"] for e in recorder.events()]
+            finally:
+                await server.stop()
+                await recursion.close()
+
+        kinds = asyncio.run(run())
+        for expected in ("dc-join", "dc-dark", "federation-failover",
+                         "dc-leave"):
+            assert expected in kinds, f"missing flight event {expected}"

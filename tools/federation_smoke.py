@@ -1,0 +1,330 @@
+#!/usr/bin/env python3
+"""Federation smoke: scripted whole-DC loss under continuous load.
+
+Boots TWO datacenter groups in one process, each a real BinderServer
+stack over its own fake-store mirror, talking over real loopback UDP:
+
+- DC ``west``: two binders (the peer group) authoritative for
+  ``*.west.fedsmoke.test``;
+- DC ``east`` (under test): one federated binder — ``/dcs`` registry,
+  registry-fed recursion routing, foreign-answer cache — serving its
+  own ``*.east.fedsmoke.test`` names locally and forwarding west names
+  cross-DC.
+
+While driving a continuous local+foreign query mix, the script kills
+the ENTIRE west group mid-run and asserts the PR's acceptance
+invariants end to end:
+
+- pre-dark: foreign answers are byte-identical to asking west directly
+  (modulo ID and the forwarder's RA bit);
+- post-dark: foreign names degrade per policy — previously-seen names
+  serve stale (NOERROR, TTL clamped), never-seen names get a
+  well-formed REFUSED, and NO query ends in a client-visible timeout;
+- local names stay line-rate: east's own-mirror latency after the
+  incident is within noise of the pre-dark control;
+- failover converges: ``last_convergence_seconds`` is recorded and the
+  measured dark->first-stale gap is bounded;
+- the scrape passes ``validate_federation_metrics``, the /status
+  snapshot carries the federation section with west dark, ``bstat``
+  renders it, and the dc-join / dc-dark / federation-failover flight
+  events all fired.
+
+Run via ``make federation-smoke`` (30 s) or set
+``BINDER_FEDERATION_SECONDS``.  Prints one JSON summary line; exit 0
+== all invariants held.
+"""
+import asyncio
+import importlib.machinery
+import importlib.util
+import json
+import os
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.dns import Message, Rcode, Type, make_query  # noqa: E402
+from binder_tpu.federation import Federation  # noqa: E402
+from binder_tpu.introspect import FlightRecorder, Introspector  # noqa: E402
+from binder_tpu.metrics.collector import MetricsCollector  # noqa: E402
+from binder_tpu.recursion import DnsClient, Recursion  # noqa: E402
+from binder_tpu.server import BinderServer  # noqa: E402
+from binder_tpu.store import FakeStore, MirrorCache  # noqa: E402
+from tools.lint import validate_federation_metrics  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOMAIN = "fedsmoke.test"
+N_NAMES = 8
+STALE_TTL_CLAMP = 15
+WEST_PEERS = 2
+
+
+class Violation(Exception):
+    pass
+
+
+def _percentile(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+
+async def _ask(port, name, qtype=Type.A, qid=1, timeout=2.5):
+    """One query, one fresh socket, NO retries: a lost answer is the
+    exact failure mode this smoke exists to catch (a dark DC must
+    never turn into a client-visible timeout)."""
+    loop = asyncio.get_running_loop()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setblocking(False)
+    sock.connect(("127.0.0.1", port))
+    try:
+        sock.send(make_query(name, qtype, qid=qid, rd=True).encode())
+        try:
+            return await asyncio.wait_for(loop.sock_recv(sock, 4096),
+                                          timeout)
+        except asyncio.TimeoutError:
+            raise Violation(f"client-visible timeout for {name}")
+    finally:
+        sock.close()
+
+
+async def _start_west():
+    """The west 'cluster': two binders sharing one mirror, each a
+    distinct UDP endpoint in east's /dcs peer list."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/test/fedsmoke/west",
+                   {"type": "service", "service": {"port": 53}})
+    for i in range(N_NAMES):
+        store.put_json(f"/test/fedsmoke/west/w{i}",
+                       {"type": "host",
+                        "host": {"address": f"10.50.0.{i + 1}",
+                                 "ttl": 60}})
+    store.start_session()
+    servers = []
+    for _ in range(WEST_PEERS):
+        s = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                         datacenter_name="west", host="127.0.0.1",
+                         port=0, collector=MetricsCollector())
+        await s.start()
+        servers.append(s)
+    return servers
+
+
+async def _start_east(west_ports):
+    """The federated binder under test: /dcs registry on its own
+    store, registry-fed routing, short upstream timeout so a dark DC
+    is detected in well under the client deadline."""
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    store.put_json("/test/fedsmoke/east",
+                   {"type": "service", "service": {"port": 53}})
+    for i in range(N_NAMES):
+        store.put_json(f"/test/fedsmoke/east/l{i}",
+                       {"type": "host",
+                        "host": {"address": f"10.51.0.{i + 1}",
+                                 "ttl": 30}})
+    store.put_json("/dcs/east", {"zones": ["east"], "peers": []})
+    store.put_json("/dcs/west",
+                   {"zones": ["west"],
+                    "peers": [f"127.0.0.1:{p}" for p in west_ports]})
+    store.start_session()
+    collector = MetricsCollector()
+    recorder = FlightRecorder()
+    federation = Federation(
+        store=store, dns_domain=DOMAIN, datacenter_name="east",
+        config={"staleTtlClampSeconds": STALE_TTL_CLAMP},
+        collector=collector, recorder=recorder)
+    federation.start()
+    recursion = Recursion(
+        zk_cache=cache, dns_domain=DOMAIN, datacenter_name="east",
+        source=federation.resolver_source(), nic_provider=lambda: [],
+        collector=collector, recorder=recorder,
+        client=DnsClient(concurrency=4, timeout=0.3))
+    federation.attach(recursion)
+    await recursion.wait_ready()
+    server = BinderServer(zk_cache=cache, dns_domain=DOMAIN,
+                          datacenter_name="east", recursion=recursion,
+                          host="127.0.0.1", port=0, collector=collector,
+                          flight_recorder=recorder)
+    server.federation = federation
+    await server.start()
+    return server, recursion, federation, recorder
+
+
+async def _parity_probe(east_port, west_port):
+    """Forwarded foreign answers must be byte-identical to asking the
+    owning DC directly, modulo the ID and the forwarder's RA bit."""
+    for i in range(N_NAMES):
+        name = f"w{i}.west.{DOMAIN}"
+        a = bytearray(await _ask(east_port, name, qid=700 + i))
+        b = bytearray(await _ask(west_port, name, qid=700 + i))
+        a[3] |= 0x80
+        b[3] |= 0x80
+        if a[2:] != b[2:]:
+            raise Violation(f"forwarded answer for {name} diverges "
+                            f"from the owning DC's")
+
+
+async def run_federation_incident(duration: float) -> dict:
+    west = await _start_west()
+    west_ports = [s.udp_port for s in west]
+    server, recursion, federation, recorder = await _start_east(west_ports)
+    port = server.udp_port
+
+    stats = {"queries": 0, "local_ok": 0, "foreign_ok": 0,
+             "foreign_stale": 0}
+    lat = {"local_pre": [], "foreign_pre": [],
+           "local_post": [], "foreign_post": []}
+    dark_at = None
+    first_stale_gap = None
+    try:
+        await _parity_probe(port, west_ports[0])
+
+        t0 = time.monotonic()
+        t_dark = t0 + max(1.0, duration * 0.55)
+        t_end = t0 + duration
+        i = 0
+        while time.monotonic() < t_end:
+            if dark_at is None and time.monotonic() >= t_dark:
+                # the incident: the WHOLE west group goes away at once
+                for s in west:
+                    await s.stop()
+                dark_at = time.monotonic()
+            i += 1
+            foreign = i % 2 == 0
+            name = (f"w{i % N_NAMES}.west.{DOMAIN}" if foreign
+                    else f"l{i % N_NAMES}.east.{DOMAIN}")
+            stats["queries"] += 1
+            start = time.perf_counter()
+            data = await _ask(port, name, qid=(i % 0xFFFF) + 1)
+            elapsed = time.perf_counter() - start
+            msg = Message.decode(data)
+            if foreign:
+                if msg.rcode != Rcode.NOERROR or not msg.answers:
+                    raise Violation(
+                        f"foreign {name} got rcode {msg.rcode} "
+                        f"({'post' if dark_at else 'pre'}-dark)")
+                want = f"10.50.0.{i % N_NAMES + 1}"
+                if msg.answers[0].address != want:
+                    raise Violation(f"foreign {name} served "
+                                    f"{msg.answers[0].address}, "
+                                    f"want {want}")
+                if dark_at is None:
+                    lat["foreign_pre"].append(elapsed)
+                else:
+                    # stale-served: TTL must be clamped per policy
+                    if msg.answers[0].ttl > STALE_TTL_CLAMP:
+                        raise Violation(
+                            f"post-dark {name} TTL "
+                            f"{msg.answers[0].ttl} > clamp "
+                            f"{STALE_TTL_CLAMP} (not stale-served?)")
+                    if first_stale_gap is None:
+                        first_stale_gap = time.monotonic() - dark_at
+                    stats["foreign_stale"] += 1
+                    lat["foreign_post"].append(elapsed)
+                stats["foreign_ok"] += 1
+            else:
+                if msg.rcode != Rcode.NOERROR or not msg.answers:
+                    raise Violation(f"local {name} got rcode {msg.rcode}")
+                lat["local_pre" if dark_at is None
+                    else "local_post"].append(elapsed)
+                stats["local_ok"] += 1
+            await asyncio.sleep(duration / 1500.0)
+
+        if dark_at is None or first_stale_gap is None:
+            raise Violation("incident never ran: raise the duration")
+
+        # a foreign name the cache has never seen: dark DC, nothing to
+        # serve stale -> well-formed REFUSED, still no timeout
+        miss = Message.decode(
+            await _ask(port, f"never.west.{DOMAIN}", qid=9999))
+        if miss.rcode != Rcode.REFUSED:
+            raise Violation(f"uncached dark-DC name got rcode "
+                            f"{miss.rcode}, want REFUSED")
+
+        # -- local latency stayed line-rate through the incident --
+        pre50 = _percentile(lat["local_pre"], 0.50)
+        post50 = _percentile(lat["local_post"], 0.50)
+        post99 = _percentile(lat["local_post"], 0.99)
+        if post50 > max(4 * pre50, pre50 + 0.005):
+            raise Violation(
+                f"local p50 degraded {pre50 * 1e3:.2f}ms -> "
+                f"{post50 * 1e3:.2f}ms while west was dark")
+        if post99 > 0.25:
+            raise Violation(f"local p99 {post99 * 1e3:.1f}ms post-dark")
+        if first_stale_gap > 5.0:
+            raise Violation(f"failover took {first_stale_gap:.1f}s to "
+                            f"first stale answer")
+
+        # -- observability: scrape, snapshot, bstat, flight events --
+        text = server.collector.expose()
+        errs = validate_federation_metrics(text)
+        if errs:
+            raise Violation(f"federation metrics: {errs[:3]}")
+        snap = Introspector(server=server, recorder=recorder).snapshot()
+        fed = snap.get("federation")
+        if not fed:
+            raise Violation("/status snapshot has no federation section")
+        if fed["dark"] != ["west"]:
+            raise Violation(f"snapshot dark set {fed['dark']}, "
+                            f"want ['west']")
+        if fed["last_convergence_seconds"] is None:
+            raise Violation("no failover convergence was recorded")
+        loader = importlib.machinery.SourceFileLoader(
+            "bstat", os.path.join(ROOT, "bin", "bstat"))
+        spec = importlib.util.spec_from_loader("bstat", loader)
+        bstat = importlib.util.module_from_spec(spec)
+        loader.exec_module(bstat)
+        rendered = bstat.render(snap)
+        if "federation:" not in rendered or "(DARK)" not in rendered:
+            raise Violation("bstat does not render the federation line")
+        kinds = [e["type"] for e in recorder.events()]
+        for expected in ("dc-join", "dc-dark", "federation-failover"):
+            if expected not in kinds:
+                raise Violation(f"missing flight event {expected}")
+
+        stats.update({
+            "duration_s": duration,
+            "west_peers": WEST_PEERS,
+            "local_p50_ms": {"pre": round(pre50 * 1e3, 3),
+                             "post_dark": round(post50 * 1e3, 3)},
+            "foreign_p50_ms": {
+                "pre": round(_percentile(lat["foreign_pre"], .5) * 1e3, 3),
+                "post_dark": round(
+                    _percentile(lat["foreign_post"], .5) * 1e3, 3)},
+            "failover_first_stale_ms": round(first_stale_gap * 1e3, 1),
+            "convergence_recorded_ms": round(
+                fed["last_convergence_seconds"] * 1e3, 1),
+        })
+        return stats
+    finally:
+        await server.stop()
+        await recursion.close()
+        for s in west:
+            if dark_at is None:
+                await s.stop()
+
+
+def run_smoke(duration: float = None) -> dict:
+    if duration is None:
+        duration = float(os.environ.get("BINDER_FEDERATION_SECONDS", "30"))
+    return asyncio.run(run_federation_incident(duration))
+
+
+def main() -> int:
+    try:
+        stats = run_smoke()
+    except Violation as e:
+        print(json.dumps({"federation_smoke": "FAIL",
+                          "violation": str(e)}))
+        return 1
+    print(json.dumps({"federation_smoke": "ok", **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

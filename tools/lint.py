@@ -899,6 +899,75 @@ def validate_mirror_metrics(text):
     return errs
 
 
+# -- federation metrics (ISSUE 11, docs/federation.md) ----------------
+#
+# The multi-DC story is told by the binder_federation_* family (registry
+# size, per-DC forward counts, the foreign-answer cache's stale/withheld
+# split, budget clamps, failover convergence) plus the recursion
+# single-flight counter.  Forward counts are the only per-DC series and
+# must carry the `dc` label; everything else is one series per process.
+# Wired into tier-1 via tests/test_federation.py and into
+# `make federation-smoke`.
+
+_FEDERATION_FAMILIES = {
+    "binder_federation_dcs": ("gauge", False),
+    "binder_federation_convergence_seconds": ("gauge", False),
+    "binder_federation_forwards_total": ("counter", True),
+    "binder_federation_foreign_hits_total": ("counter", False),
+    "binder_federation_foreign_stale_served_total": ("counter", False),
+    "binder_federation_foreign_withheld_total": ("counter", False),
+    "binder_federation_budget_clamped_total": ("counter", False),
+    "binder_federation_failovers_total": ("counter", False),
+    "binder_recursion_coalesced_total": ("counter", False),
+}
+
+
+def validate_federation_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_federation_*`` family (plus the recursion single-flight
+    counter): correct TYPE declarations, at least one sample each, a
+    ``dc`` label on every forward-count series, and no labels beyond
+    the collector's static set elsewhere.  Returns error strings;
+    empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            name, _, labels = parts[0].partition("{")
+            samples.setdefault(name, []).append(labels)
+    for family, (kind, per_dc) in _FEDERATION_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in samples:
+            errs.append(f"{family}: no samples in exposition")
+            continue
+        for labels in samples[family]:
+            # parse actual label NAMES ("notdc" must not pass a
+            # substring check for "dc")
+            names = {pair.partition("=")[0]
+                     for pair in labels.partition("}")[0].split(",")
+                     if pair}
+            if per_dc:
+                if "dc" not in names:
+                    errs.append(f"{family}: sample missing the "
+                                f"`dc` label")
+                    break
+            else:
+                stray = names - _MIRROR_ALLOWED_LABELS
+                if stray:
+                    errs.append(f"{family}: unexpected label(s) "
+                                f"{sorted(stray)}")
+                    break
+    return errs
+
+
 def is_python_script(path):
     if path.endswith(".py"):
         return True
