@@ -210,6 +210,10 @@ class DnsServer:
         # installed by BinderServer: bounds the in-flight table with
         # oldest-shed.  None = unbounded (the classic behavior).
         self.admission = None
+        # Response rate limiting (binder_tpu/policy/rrl.py), installed
+        # by BinderServer: per-client-prefix slip/drop at the UDP
+        # ingress, judged before decode.  None = unlimited.
+        self.rrl = None
         # Optional flight recorder (installed by BinderServer): the
         # engine's error path records resolver-error events on it.
         self.recorder = None
@@ -380,6 +384,26 @@ class DnsServer:
                     client_transport: Optional[str] = None,
                     ctx_box: Optional[list] = None,
                     fastpath_checked: bool = False) -> None:
+        # Response rate limiting at the UDP ingress, before decode and
+        # before any lane can spend work on the packet: a flooded
+        # prefix gets a TC slip or silence at raw-bytes cost.  While
+        # the limiter is hot the fastpath gate (BinderServer) is shut,
+        # so every direct-UDP packet surfaces here for judgment.  The
+        # TCP lane is exempt by design — a spoofed source cannot
+        # complete a handshake, and slips exist to push real clients
+        # to TCP.
+        rrl = self.rrl
+        if rrl is not None and protocol == "udp":
+            verdict = rrl.decide(src[0])
+            if verdict != rrl.SEND:
+                if verdict == rrl.SLIP:
+                    resp = rrl.slip_reply(data)
+                    if resp is not None:
+                        try:
+                            send(resp)
+                        except OSError:
+                            pass
+                return
         # Native answer-cache/zone serve for the lanes that have no C
         # drain of their own — TCP and the balancer socket.  Direct-UDP
         # packets reaching here already missed inside fastpath_drain,
@@ -569,6 +593,12 @@ class DnsServer:
         log = self.log
         burst = self._UDP_BURST
         batch_out: List[Optional[list]] = [None]  # non-None while draining
+        # RRL duty-cycle sampling tick (see ResponseRateLimiter): a
+        # cache-hit flood served entirely inside fastpath_drain would
+        # never reach rrl.decide() to trip hot(), so while the gate is
+        # open every Nth readiness event drains through Python with
+        # decide() charging N tokens per sampled packet
+        rrl_tick = [0]
         # Late (async-completed) responses — the recursion path — are
         # coalesced per event-loop pass into one sendmmsg instead of a
         # sendto syscall each: upstream answers arrive in batches on the
@@ -610,6 +640,15 @@ class DnsServer:
                       and (self.fastpath_gate is None
                            or self.fastpath_gate()))
             fp_gen = self.fastpath_gen
+            rrl = self.rrl
+            if rrl is not None:
+                rrl.sample_cost = 1.0
+                if use_fp:
+                    rrl_tick[0] += 1
+                    if rrl_tick[0] >= rrl.FASTPATH_SAMPLE_EVERY:
+                        rrl_tick[0] = 0
+                        use_fp = False
+                        rrl.sample_cost = float(rrl.FASTPATH_SAMPLE_EVERY)
             try:
                 drained = 0
                 while drained < burst:

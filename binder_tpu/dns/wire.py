@@ -182,7 +182,16 @@ def decode_name(data: bytes, off: int) -> Tuple[str, int]:
         total += length + 1
         if total > MAX_NAME_LEN:
             raise WireError("decoded name too long")
-        labels.append(data[pos:pos + length].decode("ascii", "replace").lower())
+        chunk = data[pos:pos + length]
+        if not chunk.isascii():
+            # Reject rather than replace: a U+FFFD-bearing name decodes
+            # fine but can never re-encode (the question echo in every
+            # REFUSED/FORMERR response would raise mid-respond), so
+            # tolerating it here turns hostile bytes into a serve-path
+            # exception.  Real clients put only ASCII (IDN is punycode)
+            # on the wire; anything else earns the header-only FORMERR.
+            raise WireError("non-ascii label")
+        labels.append(chunk.decode("ascii").lower())
         pos += length
     return ".".join(labels), end
 
@@ -541,6 +550,24 @@ class Message:
 
     @classmethod
     def decode(cls, data: bytes) -> "Message":
+        """Strict decode; raises WireError for ANYTHING malformed.
+
+        The armor wrapper is the contract the serve lanes build on:
+        every lane maps WireError to FORMERR-or-drop, so a decoder bug
+        (struct.error, IndexError, a codec surprise) reached by a
+        hostile frame must degrade to the same verdict instead of
+        becoming an unhandled exception in a read loop.  The corpus
+        replay in tests/test_hostile.py pins this."""
+        try:
+            return cls._decode(data)
+        except WireError:
+            raise
+        except Exception as e:
+            raise WireError(f"undecodable message "
+                            f"({type(e).__name__}: {e})") from e
+
+    @classmethod
+    def _decode(cls, data: bytes) -> "Message":
         if len(data) < 12:
             raise WireError("message shorter than header")
         (mid, flags, qd, an, ns, ar) = struct.unpack_from(">HHHHHH", data, 0)

@@ -274,14 +274,16 @@ class Introspector:
         srv = self.server
         pol = getattr(srv, "_policy", None) if srv is not None else None
         adm = getattr(srv, "_admission", None) if srv is not None else None
+        rrl = getattr(srv, "_rrl", None) if srv is not None else None
         brk = (getattr(self.recursion, "breakers", None)
                if self.recursion is not None else None)
-        if pol is None and adm is None and brk is None:
+        if pol is None and adm is None and rrl is None and brk is None:
             return None
         return {
             "degradation": None if pol is None else pol.introspect(),
             "admission": None if adm is None else adm.introspect(
                 srv.engine if srv is not None else None),
+            "rrl": None if rrl is None else rrl.introspect(),
             "breakers_open": 0 if brk is None else brk.open_count(),
         }
 

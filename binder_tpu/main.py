@@ -321,6 +321,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
         # ({"enabled": false} turns one off)
         degradation=dict(options.get("degradation") or {}),
         admission=dict(options.get("admission") or {}),
+        # response rate limiting at the UDP ingress (hostile-internet
+        # posture, docs/operations.md): same on-by-default convention
+        rrl=dict(options.get("rrl") or {}),
         # shard workers share ONE port via SO_REUSEPORT (the kernel
         # balances) and leave the canonical announce lines to the
         # supervisor, which prints them once the whole group serves
@@ -438,10 +441,15 @@ def _wire_shard_worker(server: BinderServer, store, metrics, collector,
             await asyncio.sleep(1.0)
             try:
                 collector.fold()   # natively counted serves included
+                rrl = getattr(server, "_rrl", None)
+                adm = getattr(server, "_admission", None)
                 store.send(protocol.stats_frame(
                     requests.total(), server.zk_cache.gen,
                     server.zk_cache.epoch, server.zk_cache.is_ready(),
-                    len(server.engine.inflight)))
+                    len(server.engine.inflight),
+                    rrl_dropped=(rrl.dropped if rrl is not None else 0),
+                    shed=(sum(adm.shed_counts.values())
+                          if adm is not None else 0)))
             except Exception:
                 log.exception("shard stats report failed")
 

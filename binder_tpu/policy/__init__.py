@@ -10,11 +10,14 @@ Three cooperating levers, consuming PR 2's observability substrate:
   probing, and the p95 hedge stagger for recursion forwards;
 - :class:`AdmissionControl` — overload shedding: bounded in-flight
   table with oldest-shed and per-client token buckets for
-  recursion-triggering queries.
+  recursion-triggering queries;
+- :class:`ResponseRateLimiter` — RRL-style per-client-prefix
+  slip/drop at the UDP ingress (hostile-internet hardening).
 """
 from binder_tpu.policy.admission import AdmissionControl
 from binder_tpu.policy.breaker import CircuitBreaker, PeerBreakers
 from binder_tpu.policy.degrade import DegradationPolicy
+from binder_tpu.policy.rrl import ResponseRateLimiter
 
 __all__ = ["AdmissionControl", "CircuitBreaker", "PeerBreakers",
-           "DegradationPolicy"]
+           "DegradationPolicy", "ResponseRateLimiter"]

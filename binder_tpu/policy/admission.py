@@ -39,7 +39,8 @@ DEFAULT_RECURSION_BURST = 100.0
 #: spoofing; an evicted client simply starts with a full bucket
 MAX_CLIENTS = 4096
 
-SHED_REASONS = ("inflight-overflow", "recursion-ratelimit")
+SHED_REASONS = ("inflight-overflow", "recursion-ratelimit",
+                "response-ratelimit")
 
 
 class AdmissionControl:

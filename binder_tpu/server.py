@@ -177,6 +177,7 @@ class BinderServer:
                  flight_recorder=None,
                  degradation: Optional[dict] = None,
                  admission: Optional[dict] = None,
+                 rrl: Optional[dict] = None,
                  reuse_port: bool = False,
                  announce: bool = True) -> None:
         self.log = log or logging.getLogger("binder.server")
@@ -281,6 +282,45 @@ class BinderServer:
                 collector=self.collector, recorder=flight_recorder,
                 log=self.log)
             self.resolver.admission = self._admission
+        # Response rate limiting (binder_tpu/policy/rrl.py): per-client-
+        # prefix slip/drop at the UDP ingress.  Same config convention as
+        # admission — None disables (direct construction / tests), a
+        # config block (even empty) enables with defaults.
+        self._rrl = None
+        if rrl is not None:
+            from binder_tpu.policy import ResponseRateLimiter
+            self._rrl = ResponseRateLimiter.from_config(
+                rrl,
+                note_shed=(self._admission._note_shed
+                           if self._admission is not None else None),
+                recorder=flight_recorder, log=self.log)
+        self._rrl_children: dict = {}
+        self._rrl_folded: dict = {}
+        if self._rrl is not None:
+            for field, help_text in (
+                ("responses", "UDP responses admitted by response rate "
+                 "limiting"),
+                ("slipped", "rate-limited UDP queries answered with a "
+                 "TC=1 slip (client retries over TCP)"),
+                ("dropped", "rate-limited UDP queries dropped silently"),
+                ("evictions", "RRL prefix buckets evicted at the LRU "
+                 "cap"),
+            ):
+                child = self.collector.counter(
+                    "binder_rrl_" + field + "_total", help_text).labelled()
+                child.inc(0)   # series exists from scrape 1
+                self._rrl_children[field] = child
+            self.collector.gauge(
+                "binder_rrl_buckets",
+                "client prefixes currently tracked by response rate "
+                "limiting"
+            ).set_function(lambda: float(len(self._rrl._buckets)))
+            self.collector.gauge(
+                "binder_rrl_active",
+                "1 while response rate limiting shed traffic recently "
+                "(the hostile-flood posture; also closes the native "
+                "fastpath gate)"
+            ).set_function(lambda: 1.0 if self._rrl.hot() else 0.0)
         if recursion is not None and hasattr(recursion, "engine_after"):
             # arm the recursion fast path: its future callback completes
             # the query AND runs the engine's after hook itself
@@ -317,6 +357,7 @@ class BinderServer:
         self.engine.on_after = self._on_after
         self.engine.recorder = flight_recorder
         self.engine.admission = self._admission
+        self.engine.rrl = self._rrl
         # the engine's cap-refusal log line is rate-limited, so the
         # counter is the only complete record — surface it in the scrape
         self._cap_refusal_child = self.collector.counter(
@@ -1764,6 +1805,14 @@ class BinderServer:
                 if d > 0:
                     child.inc(d)
                     folded[field] = snap[field]
+            if self._rrl is not None:
+                rfolded = self._rrl_folded
+                for field, child in self._rrl_children.items():
+                    val = getattr(self._rrl, field)
+                    d = val - rfolded.get(field, 0)
+                    if d > 0:
+                        child.inc(d)
+                        rfolded[field] = val
 
     def _fold_fastpath_metrics(self) -> None:
         """Fold the C fast path's monotonic counters into the Prometheus
@@ -1820,11 +1869,15 @@ class BinderServer:
     def _fastpath_active(self) -> bool:
         """The C path bypasses Python entirely, so it must stand down
         whenever every query has to surface: a probe consumer attached,
-        or per-query logging on WITHOUT the native log ring (with the
-        ring armed, the C path produces the log lines itself)."""
+        per-query logging on WITHOUT the native log ring (with the
+        ring armed, the C path produces the log lines itself), or
+        response rate limiting actively shedding a flood (the limiter
+        judges per-prefix in Python; serving cache hits in C would
+        answer the flood before RRL could see it)."""
         return (not self.p_req_start.enabled
                 and not self.p_req_done.enabled
-                and (not self.query_log or self._log_ring))
+                and (not self.query_log or self._log_ring)
+                and (self._rrl is None or not self._rrl.hot()))
 
     # -- native query-log ring plumbing --
 

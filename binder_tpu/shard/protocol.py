@@ -92,9 +92,16 @@ def hello_frame(shard: int, pid: int, udp_port: int, tcp_port: int,
 
 
 def stats_frame(requests: float, gen: int, epoch: int, ready: bool,
-                inflight: int) -> dict:
+                inflight: int, rrl_dropped: int = 0,
+                shed: int = 0) -> dict:
+    """1 Hz worker report.  ``rrl_dropped``/``shed`` (response-rate-
+    limit drops and total admission sheds, both monotonic per worker
+    incarnation) fold into ``binder_shard_rrl_dropped`` /
+    ``binder_shard_shed`` so a flood's per-shard spread is scrapeable
+    from the supervisor; older workers simply omit them (defaults)."""
     return {"op": "stats", "requests": requests, "gen": gen,
-            "epoch": epoch, "ready": ready, "inflight": inflight}
+            "epoch": epoch, "ready": ready, "inflight": inflight,
+            "rrl_dropped": rrl_dropped, "shed": shed}
 
 
 def snapshot_order(domains) -> List[str]:

@@ -67,6 +67,7 @@ class ShardLink:
 
     __slots__ = ("shard", "proc", "sock", "wbuf", "writer_armed",
                  "hello", "stats", "stats_at", "last_requests",
+                 "last_rrl_dropped", "last_shed",
                  "spawned_mono", "rbuf", "closed",
                  "snap_queue", "snap_sent", "snap_started")
 
@@ -84,6 +85,10 @@ class ShardLink:
         # last raw requests figure this incarnation reported, for the
         # monotonic fold into binder_shard_requests across respawns
         self.last_requests = 0.0
+        # same per-incarnation baselines for the hostile-traffic fold
+        # (binder_shard_rrl_dropped / binder_shard_shed)
+        self.last_rrl_dropped = 0.0
+        self.last_shed = 0.0
         self.spawned_mono = time.monotonic()
         self.closed = False
         # chunked attach-time snapshot state: the walk queue of owner
@@ -155,6 +160,15 @@ class ShardSupervisor:
         requests = c.counter("binder_shard_requests",
                              "requests completed per shard (folded "
                              "monotonically across respawns)")
+        rrl_drops = c.counter("binder_shard_rrl_dropped",
+                              "response-rate-limit drops per shard "
+                              "(folded monotonically across respawns)")
+        shed = c.counter("binder_shard_shed",
+                         "queries shed by admission control per shard "
+                         "(all reasons, folded monotonically across "
+                         "respawns)")
+        self._rrl_drop_children = {}
+        self._shed_children = {}
         for i in range(self.n):
             labels = {"shard": str(i)}
             up.set_function(lambda i=i: self._up(i), labels)
@@ -169,6 +183,12 @@ class ShardSupervisor:
             qc = requests.labelled(labels)
             qc.inc(0)
             self._request_children[i] = qc
+            dc = rrl_drops.labelled(labels)
+            dc.inc(0)
+            self._rrl_drop_children[i] = dc
+            sc = shed.labelled(labels)
+            sc.inc(0)
+            self._shed_children[i] = sc
 
     def _up(self, i: int) -> float:
         link = self.links.get(i)
@@ -473,6 +493,17 @@ class ShardSupervisor:
             self._request_children[link.shard].inc(delta)
             self._requests_total[link.shard] = \
                 self._requests_total.get(link.shard, 0.0) + delta
+        for key, attr, children in (
+                ("rrl_dropped", "last_rrl_dropped",
+                 self._rrl_drop_children),
+                ("shed", "last_shed", self._shed_children)):
+            val = float(frame.get(key) or 0.0)
+            d = val - getattr(link, attr)
+            if d < 0:
+                d = val
+            setattr(link, attr, val)
+            if d > 0:
+                children[link.shard].inc(d)
 
     def _sever(self, link: ShardLink) -> None:
         """A dead mutation log means a dead shard: a worker that lost

@@ -356,8 +356,9 @@ class MirrorCache:
         self.nodes: Dict[str, TreeNode] = {}
         self.rev_lookup: Dict[str, TreeNode] = {}
         # offer the node index as the store's direct event routing
-        # table (fake store / shard replica feed accept; real ZooKeeper
-        # declines and keeps per-path watchers)
+        # table (fake store / shard replica feed route synchronously
+        # through it; the ZooKeeper client uses it for watch-event
+        # dispatch and shared, batched wire watches)
         getattr(store, "bind_source", lambda nodes: False)(self.nodes)
         # staleness instrumentation: monotonic instants of the last
         # applied mutation and the last full rebuild.  While the store

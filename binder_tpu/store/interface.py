@@ -216,20 +216,20 @@ class StoreClient:
     # TreeNode.  The generic path (watcher object + listener slots) is
     # ~190 bytes per node, which at a million names is the difference
     # between a mirror that fits and one that doesn't.  Stores that can
-    # route events straight to a bound node (the fake store and the
-    # shard replica feed) override these with a bare domain->node dict;
-    # the default keeps the historical watcher semantics for real
-    # ZooKeeper (whose one-shot wire watches need the re-registration
-    # machinery anyway).
+    # route events straight to a bound node override these with a bare
+    # domain->node dict: the fake store and the shard replica feed
+    # route synchronously, and the real ZooKeeper client uses the index
+    # both for dispatch and to batch its wire watches (one data watch
+    # per znode, children watches only where children can exist —
+    # zk_client module docstring).  The default declines and keeps the
+    # historical per-path watcher semantics.
 
     def bind_source(self, nodes) -> bool:
         """Offer the mirror's domain->node index as a direct event
-        routing table.  Stores that can route events by domain (the
-        fake store, and through it the shard replica feed) accept and
-        return True — per-node binds then carry no per-node state at
-        all.  The default declines; such stores keep per-path watcher
-        objects (real ZooKeeper needs them for its one-shot wire
-        watches)."""
+        routing table.  Stores that can route events by domain accept
+        and return True — per-node binds then carry no per-node state
+        at all.  The default declines; such stores keep per-path
+        watcher objects."""
         return False
 
     def bind_node(self, path: str, node) -> None:

@@ -23,6 +23,23 @@ Semantics:
   re-issues the fetch, re-arming the watch and emitting fresh state to
   the cache (state, not deltas — same contract as FakeStore).
 - **Ping**: every timeout/3 to keep the session alive.
+- **Shared watches** (ROADMAP 3b): when the mirror offers its
+  domain→node index via ``bind_source``, the client stops allocating a
+  per-path ``_ZKWatcher`` (~190 B/znode) and stops registering two wire
+  watches per znode.  Each bound node costs ONE getData(watch=1) whose
+  trailing Stat says whether the node has children; the additional
+  getChildren2(watch=1) goes only to nodes that have children now or
+  could grow them — structural nodes (no record), container records
+  (services), anything non-host — while host-record leaves, the ~30:1
+  bulk of a production zone, stop at the data watch.  Watch events are
+  dispatched straight through the mirror index (path → domain → node).
+  At a million names this nearly halves both the server-side watch
+  table and the session re-establishment chatter: a rebuild issues
+  ~nodes + directories requests instead of 2×nodes.  Residual
+  relaxation: a HOST-record leaf that gains a first child is only
+  noticed at its next data touch or session rebuild — in this data
+  model children hang off service records, which always keep a
+  children watch.
 """
 from __future__ import annotations
 
@@ -117,6 +134,11 @@ class ZKClient(SessionStateMixin, StoreClient):
 
         self._session_cbs: List[Callable[[], None]] = []
         self._watchers: Dict[str, _ZKWatcher] = {}
+        # mirror's domain->node index once bind_source was accepted;
+        # None keeps the legacy one-watcher-per-path mode (explicit
+        # watcher() consumers — e.g. the federation registry — always
+        # use that mode regardless)
+        self._shared_nodes = None
         self._connected = False
         self._closed = False
 
@@ -155,6 +177,35 @@ class ZKClient(SessionStateMixin, StoreClient):
             w = _ZKWatcher(self, path)
             self._watchers[path] = w
         return w
+
+    # -- shared-watch mode (mirror fast binding, see module docstring) --
+
+    def bind_source(self, nodes) -> bool:
+        """Accept the mirror's domain->node index: per-node binds then
+        carry no per-node client state, and leaf znodes register one
+        wire watch instead of two (the data watch; directory-ness comes
+        from that request's trailing Stat)."""
+        self._shared_nodes = nodes
+        return True
+
+    @staticmethod
+    def _path_domain(path: str) -> str:
+        """``/com/foo/web`` -> ``web.foo.com`` (inverse of
+        ``cache.domain_to_path``)."""
+        return ".".join(reversed([p for p in path.split("/") if p])).lower()
+
+    def bind_node(self, path: str, node) -> None:
+        if self._shared_nodes is None:
+            StoreClient.bind_node(self, path, node)
+            return
+        self._schedule_shared(path, "bind")
+
+    def unbind_node(self, path: str, node) -> None:
+        if self._shared_nodes is None:
+            StoreClient.unbind_node(self, path, node)
+        # shared mode: nothing to tear down — the mirror already removed
+        # the node from its index, so a later one-shot watch event for
+        # the path dispatches to nothing and is dropped
 
     def is_connected(self) -> bool:
         """True only while a live session is established.  The bool
@@ -367,6 +418,20 @@ class ZKClient(SessionStateMixin, StoreClient):
             raise ConnectionError(f"zk: getData({path}) err {err}")
         return buf.buffer() or b""
 
+    async def get_data2(self, path: str, watch: bool = False):
+        """getData returning ``(data, stat_dict)`` instead of discarding
+        the trailing Stat — its ``numChildren`` is how the shared-watch
+        sync learns directory-ness without a getChildren round trip.
+        None when the node does not exist."""
+        err, buf = await self._call(OpCode.GETDATA,
+                                    jute.string(path) + jute.boolean(watch))
+        if err == Err.NONODE:
+            return None
+        if err != Err.OK:
+            raise ConnectionError(f"zk: getData({path}) err {err}")
+        data = buf.buffer() or b""
+        return data, jute.read_stat(buf)
+
     async def exists(self, path: str, watch: bool = False) -> bool:
         err, buf = await self._call(OpCode.EXISTS,
                                     jute.string(path) + jute.boolean(watch))
@@ -434,6 +499,64 @@ class ZKClient(SessionStateMixin, StoreClient):
         except (ConnectionError, asyncio.CancelledError):
             pass  # reconnect path will resync
 
+    # -- shared-watch sync (mirror-bound paths, no per-path watcher) --
+
+    def _schedule_shared(self, path: str, want: str) -> None:
+        if not self._connected or self._shared_nodes is None:
+            return  # the session callback will rebind + resync everything
+        task = asyncio.ensure_future(self._sync_shared(path, want))
+        self._tasks.append(task)
+        task.add_done_callback(self._tasks.remove)
+
+    def _shared_node(self, path: str):
+        nodes = self._shared_nodes
+        if nodes is None:
+            return None
+        return nodes.get(self._path_domain(path))
+
+    async def _sync_shared(self, path: str, want: str) -> None:
+        """Fetch current state with fresh watches and deliver it to the
+        mirror node the path maps to (dropped if it was unbound since).
+
+        ``bind`` is the full pass: one watched getData whose Stat
+        decides whether a watched getChildren follows — only for nodes
+        that have children now, or whose record is a container type
+        (dict-shaped, e.g. a service) and so may grow children later.
+        Host leaves — the million-name bulk — stop at the data watch.
+        """
+        node = self._shared_node(path)
+        if node is None:
+            return
+        try:
+            if want == "children":
+                kids = await self.get_children(path, watch=True)
+                if kids is None:
+                    await self._arm_exists_watch(path)
+                    return
+                node.on_children_changed(kids)
+                return
+            res = await self.get_data2(path, watch=True)
+            if res is None:
+                await self._arm_exists_watch(path)
+                return
+            data, stat = res
+            node.on_data_changed(data)
+            # Children watch for every node EXCEPT host-record leaves
+            # (compact tuples — the ~30:1 bulk of a production zone).
+            # Structural nodes (no record: the mirror root and interior
+            # path components) and container records (dict-shaped, e.g.
+            # services) may grow children at any time, so they keep the
+            # watch even while childless; a host leaf that somehow has
+            # children is caught by the Stat.  On a plain data touch
+            # the Stat doubles as a heal: children that appeared while
+            # a node was watch-less get picked up here.
+            if stat["numChildren"] > 0 or type(node.rec) is not tuple:
+                kids = await self.get_children(path, watch=True)
+                if kids is not None:
+                    node.on_children_changed(kids)
+        except (ConnectionError, asyncio.CancelledError):
+            pass  # reconnect path will resync
+
     async def _arm_exists_watch(self, path: str) -> None:
         if path in self._exists_watch:
             return
@@ -442,24 +565,40 @@ class ZKClient(SessionStateMixin, StoreClient):
             if await self.exists(path, watch=True):
                 # created between the NONODE and the exists call
                 self._exists_watch.discard(path)
-                self._schedule_sync(path, "children")
-                self._schedule_sync(path, "data")
+                self._resync_created(path)
         except (ConnectionError, asyncio.CancelledError):
             self._exists_watch.discard(path)
+
+    def _resync_created(self, path: str) -> None:
+        """A watched path (re)appeared: schedule the full fetch through
+        whichever binding mode covers it.  Both schedules are cheap
+        no-op tasks when the path has no listener of that kind."""
+        self._schedule_sync(path, "children")
+        self._schedule_sync(path, "data")
+        self._schedule_shared(path, "bind")
 
     def _on_watch_event(self, etype: int, path: str) -> None:
         if self.m_notifications is not None:
             self.m_notifications.inc()
         self._exists_watch.discard(path)
         if etype == EventType.CREATED:
-            self._schedule_sync(path, "children")
-            self._schedule_sync(path, "data")
+            self._resync_created(path)
         elif etype == EventType.DATA_CHANGED:
             self._schedule_sync(path, "data")
+            self._schedule_shared(path, "data")
         elif etype == EventType.CHILDREN_CHANGED:
             self._schedule_sync(path, "children")
+            self._schedule_shared(path, "children")
         elif etype == EventType.DELETED:
             # parent's children watch drives the unbind; re-arm creation
-            task = asyncio.ensure_future(self._arm_exists_watch(path))
-            self._tasks.append(task)
-            task.add_done_callback(self._tasks.remove)
+            # for paths something still listens on (for shared mode
+            # that's a node still in the mirror index — notably the
+            # mirror ROOT, which has no watched parent to notice its
+            # re-creation)
+            wants = ((path in self._watchers
+                      and self._watchers[path].has_listeners)
+                     or self._shared_node(path) is not None)
+            if wants:
+                task = asyncio.ensure_future(self._arm_exists_watch(path))
+                self._tasks.append(task)
+                task.add_done_callback(self._tasks.remove)

@@ -259,9 +259,13 @@ class ZKTestServer:
                 return Err.NONODE, b""
             if watch:
                 self.state.data_watches.setdefault(path, set()).add(session.id)
+            # numChildren must be real: the shared-watch client decides
+            # from this stat whether the node is a directory that needs
+            # its own children watch (zk_client._sync_shared)
             return Err.OK, (jute.buffer(node.data)
                             + jute.pack_stat(version=node.version,
-                                             data_length=len(node.data)))
+                                             data_length=len(node.data),
+                                             num_children=len(node.children)))
 
         if opcode == OpCode.EXISTS:
             path = buf.string()

@@ -28,9 +28,18 @@
  *
  * Usage:
  *   dnsblast -p PORT [-H HOST] [-n QUERIES] [-w WINDOW] -t FILE
- *            [-m udp|tcp|tcp1] [-T CONNS]
+ *            [-m udp|tcp|tcp1] [-T CONNS] [-S SOURCES]
  * where FILE contains length-prefixed (u16 BE) DNS query wires to cycle.
  * Output: one JSON line {qps, elapsed_s, p50_us, p99_us, errors, retries}.
+ *
+ * -S SOURCES (UDP mode): spread the load over that many sockets, each
+ * bound to its own 127.20.x.y loopback source address (Linux accepts
+ * any 127/8 address unconfigured).  One socket = one mega-client, which
+ * is exactly the flood shape per-client admission control sheds; the
+ * recursion bench axes use -S so they measure forwarding under the
+ * server's PRODUCTION admission limits instead of lifting them in
+ * config.  If a source bind fails (non-Linux), the socket falls back to
+ * the default source — the load still runs, just unspread.
  */
 
 #include <arpa/inet.h>
@@ -374,9 +383,10 @@ int main(int argc, char **argv) {
     long n_queries = 50000;
     int window = 64;
     int nconns = 8;
+    int nsources = 1;
 
     int c;
-    while ((c = getopt(argc, argv, "H:p:n:w:t:m:T:")) != -1) {
+    while ((c = getopt(argc, argv, "H:p:n:w:t:m:T:S:")) != -1) {
         switch (c) {
         case 'H': host = optarg; break;
         case 'p': port = atoi(optarg); break;
@@ -385,11 +395,12 @@ int main(int argc, char **argv) {
         case 't': tmpl_path = optarg; break;
         case 'm': mode = optarg; break;
         case 'T': nconns = atoi(optarg); break;
+        case 'S': nsources = atoi(optarg); break;
         default:
             fprintf(stderr,
                     "usage: dnsblast -p port [-H host] [-n queries] "
                     "[-w window] [-m udp|tcp|tcp1] [-T conns] "
-                    "-t templates\n");
+                    "[-S sources] -t templates\n");
             return 2;
         }
     }
@@ -406,6 +417,8 @@ int main(int argc, char **argv) {
     if (window < 1) window = 1;
     if ((long)window > n_queries) window = (int)n_queries;
     if (nconns < 1) nconns = 1;
+    if (nsources < 1) nsources = 1;
+    if (nsources > 4096) nsources = 4096;  /* 127.20.x.y address budget */
 
     std::vector<std::string> templates = load_templates(tmpl_path);
 
@@ -426,11 +439,31 @@ int main(int argc, char **argv) {
         return 2;
     }
 
-    int fd = socket(AF_INET, SOCK_DGRAM, 0);
-    if (fd < 0) die("socket");
-    if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0) die("connect");
-    int rcvbuf = 1 << 20;
-    (void)setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof(rcvbuf));
+    /* -S: one socket per simulated client source (127.20.x.y); query
+     * idx is pinned to socket idx % nsources so retransmits keep their
+     * original 4-tuple and per-client accounting stays coherent */
+    std::vector<int> fds((size_t)nsources, -1);
+    for (int j = 0; j < nsources; j++) {
+        int fd = socket(AF_INET, SOCK_DGRAM, 0);
+        if (fd < 0) die("socket");
+        if (nsources > 1) {
+            struct sockaddr_in src;
+            memset(&src, 0, sizeof(src));
+            src.sin_family = AF_INET;
+            char addr[32];
+            snprintf(addr, sizeof(addr), "127.20.%d.%d", j / 250,
+                     (j % 250) + 1);
+            if (inet_pton(AF_INET, addr, &src.sin_addr) == 1)
+                (void)bind(fd, (struct sockaddr *)&src, sizeof(src));
+            /* bind failure: fall through unbound (non-Linux) */
+        }
+        if (connect(fd, (struct sockaddr *)&sa, sizeof(sa)) != 0)
+            die("connect");
+        int rcvbuf = 1 << 20;
+        (void)setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf,
+                         sizeof(rcvbuf));
+        fds[(size_t)j] = fd;
+    }
 
     std::vector<Outstanding> state(65536);
     std::vector<double> latencies;
@@ -449,7 +482,8 @@ int main(int argc, char **argv) {
         if (is_retry) o.retried = true;
         /* best-effort like the Python client; drops are re-sent by the
          * retransmit sweep */
-        (void)send(fd, sendbuf.data(), sendbuf.size(), 0);
+        (void)send(fds[(size_t)(idx % nsources)], sendbuf.data(),
+                   sendbuf.size(), 0);
     };
 
     double t0 = now_s();
@@ -457,9 +491,14 @@ int main(int argc, char **argv) {
 
     unsigned char rbuf[65535];
     double last_sweep = t0;
+    std::vector<struct pollfd> pfds((size_t)nsources);
     while (received < n_queries) {
-        struct pollfd pfd = {fd, POLLIN, 0};
-        int rv = poll(&pfd, 1, 250);
+        for (size_t j = 0; j < fds.size(); j++) {
+            pfds[j].fd = fds[j];
+            pfds[j].events = POLLIN;
+            pfds[j].revents = 0;
+        }
+        int rv = poll(pfds.data(), (nfds_t)pfds.size(), 250);
         double now = now_s();
         if (now - t0 > kRunTimeout) {
             fprintf(stderr, "dnsblast: run timed out (%ld/%ld answered)\n",
@@ -467,24 +506,30 @@ int main(int argc, char **argv) {
             return 1;
         }
         if (rv > 0) {
-            for (;;) {
-                ssize_t got = recv(fd, rbuf, sizeof(rbuf), MSG_DONTWAIT);
-                if (got < 0) {
-                    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-                    if (errno == EINTR) continue;
-                    die("recv");
+            for (size_t j = 0; j < fds.size() && received < n_queries;
+                 j++) {
+                if (!(pfds[j].revents & POLLIN)) continue;
+                for (;;) {
+                    ssize_t got = recv(fds[j], rbuf, sizeof(rbuf),
+                                       MSG_DONTWAIT);
+                    if (got < 0) {
+                        if (errno == EAGAIN || errno == EWOULDBLOCK)
+                            break;
+                        if (errno == EINTR) continue;
+                        die("recv");
+                    }
+                    if (got < 4) continue;
+                    unsigned qid = ((unsigned)rbuf[0] << 8) | rbuf[1];
+                    Outstanding &o = state[qid];
+                    if (!o.in_flight) continue;  /* dup of a retransmit */
+                    now = now_s();
+                    o.in_flight = false;
+                    if (!o.retried) latencies.push_back(now - o.sent_at);
+                    if (rbuf[3] & 0x0f) errors++;
+                    received++;
+                    if (next_idx < n_queries) send_query(next_idx++, false);
+                    if (received >= n_queries) break;
                 }
-                if (got < 4) continue;
-                unsigned qid = ((unsigned)rbuf[0] << 8) | rbuf[1];
-                Outstanding &o = state[qid];
-                if (!o.in_flight) continue;  /* dup response to a retransmit */
-                now = now_s();
-                o.in_flight = false;
-                if (!o.retried) latencies.push_back(now - o.sent_at);
-                if (rbuf[3] & 0x0f) errors++;
-                received++;
-                if (next_idx < n_queries) send_query(next_idx++, false);
-                if (received >= n_queries) break;
             }
         }
         if (now - last_sweep >= 0.25) {
@@ -499,7 +544,7 @@ int main(int argc, char **argv) {
         }
     }
     double elapsed = now_s() - t0;
-    close(fd);
+    for (int fd : fds) close(fd);
     emit_result(n_queries, elapsed, latencies, errors, retries);
     return 0;
 }

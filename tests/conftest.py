@@ -19,3 +19,44 @@ if _flag not in os.environ.get("XLA_FLAGS", ""):
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import logging
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _restore_binder_logger_state():
+    """Snapshot/restore handler, level, and propagate state for the
+    binder logger tree around every test.
+
+    Several tests (log ring, query log, zlogcat) attach handlers or
+    adjust levels on the shared "binder"/"binder.server" loggers; a
+    leaked handler changes what LATER tests' servers consider "logging
+    armed" (e.g. the TCP fastpath gate's log-ring check), which made
+    their behavior depend on test ORDER — green alone, red in the full
+    run.  Restoring the exact prior state makes every test see the
+    logger tree cold."""
+    names = [None] + [n for n in logging.Logger.manager.loggerDict
+                      if n == "binder" or n.startswith("binder.")]
+    saved = {}
+    for name in names:
+        logger = logging.getLogger(name)
+        saved[name] = (list(logger.handlers), logger.level,
+                       logger.propagate, logger.disabled)
+    yield
+    for name, (handlers, level, propagate, disabled) in saved.items():
+        logger = logging.getLogger(name)
+        logger.handlers[:] = handlers
+        logger.setLevel(level)
+        logger.propagate = propagate
+        logger.disabled = disabled
+    # loggers born mid-test keep their objects (they may be cached by
+    # the code under test) but must not keep leaked handlers
+    for name in logging.Logger.manager.loggerDict:
+        if (name not in saved
+                and (name == "binder" or name.startswith("binder."))):
+            logger = logging.getLogger(name)
+            logger.handlers[:] = []
+            logger.setLevel(logging.NOTSET)
+            logger.propagate = True

@@ -323,6 +323,129 @@ class TestMirrorMetricsExposition:
         assert chunks and float(chunks[0].split()[-1]) >= 1.0
 
 
+class TestSharedWatchScaling:
+    """ROADMAP 3b: with the mirror index offered via ``bind_source``,
+    the real-ZK owner registers ONE wire watch per host leaf (the data
+    watch) and children watches only where children can exist, so the
+    ensemble-side watch table — and the session re-establishment
+    chatter — scales with directories, not names."""
+
+    N_HOSTS = 40
+    N_SVC = 4
+    N_LB = 3
+
+    HOST = {"type": "host", "host": {"address": "10.3.0.1"}}
+    SVC = {"type": "service",
+           "service": {"srvce": "_http", "proto": "_tcp", "port": 80}}
+    LB = {"type": "load_balancer",
+          "load_balancer": {"address": "10.4.0.1"}}
+
+    def test_watch_table_scales_with_directories_not_names(self):
+        from binder_tpu.store.zk_client import ZKClient
+        from binder_tpu.store.zk_testserver import ZKTestServer
+
+        async def wait_for(pred, timeout=8.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                if pred():
+                    return True
+                await asyncio.sleep(0.01)
+            return False
+
+        async def run():
+            server = ZKTestServer()
+            await server.start()
+            writer = ZKClient("127.0.0.1", port=server.port,
+                              session_timeout_ms=4000)
+            client = None
+            try:
+                assert await wait_for(writer.is_connected)
+                for i in range(self.N_HOSTS):
+                    await writer.mkdirp(f"/com/foo/h{i:03d}",
+                                        json.dumps(self.HOST).encode())
+                for s in range(self.N_SVC):
+                    await writer.mkdirp(f"/com/foo/svc{s}",
+                                        json.dumps(self.SVC).encode())
+                    for j in range(self.N_LB):
+                        await writer.mkdirp(f"/com/foo/svc{s}/lb{j}",
+                                            json.dumps(self.LB).encode())
+
+                client = ZKClient("127.0.0.1", port=server.port,
+                                  session_timeout_ms=4000)
+                cache = MirrorCache(client, DOMAIN)
+                assert client._shared_nodes is cache.nodes  # mode is on
+                client.start()
+
+                total = 1 + self.N_HOSTS + self.N_SVC * (1 + self.N_LB)
+                assert await wait_for(lambda: len(cache.nodes) == total)
+                state = server.state
+                sid = client._session_id
+
+                def mine(table):
+                    return {p for p, sids in table.items() if sid in sids}
+
+                # one data watch per mirrored znode...
+                assert await wait_for(
+                    lambda: len(mine(state.data_watches)) == total)
+                # ...but children watches ONLY on the root and the
+                # service containers — none of the 52 host/lb leaves
+                dirs = {"/com/foo"} | {f"/com/foo/svc{s}"
+                                       for s in range(self.N_SVC)}
+                assert mine(state.child_watches) == dirs
+                assert len(dirs) * 8 < total  # the scaling claim itself
+
+                # liveness is not traded away: every mutation class the
+                # per-path watchers caught still flows to the mirror.
+                await writer.mkdirp("/com/foo/hnew",
+                                    json.dumps(self.HOST).encode())
+                assert await wait_for(
+                    lambda: cache.lookup("hnew.foo.com") is not None)
+                await writer.set_data(
+                    "/com/foo/svc0/lb0",
+                    b'{"type": "load_balancer", '
+                    b'"load_balancer": {"address": "10.4.9.9"}}')
+                assert await wait_for(
+                    lambda: cache.lookup("lb0.svc0.foo.com").ip
+                    == "10.4.9.9")
+                # a child appearing under an EXISTING container
+                await writer.mkdirp("/com/foo/svc1/lbnew",
+                                    json.dumps(self.LB).encode())
+                assert await wait_for(
+                    lambda: cache.lookup("lbnew.svc1.foo.com") is not None)
+                # the leaf->parent case the container rule exists for:
+                # a service created EMPTY gains its first child later
+                await writer.mkdirp("/com/foo/svc9",
+                                    json.dumps(self.SVC).encode())
+                assert await wait_for(
+                    lambda: cache.lookup("svc9.foo.com") is not None)
+                await writer.mkdirp("/com/foo/svc9/lb0",
+                                    json.dumps(self.LB).encode())
+                assert await wait_for(
+                    lambda: cache.lookup("lb0.svc9.foo.com") is not None)
+
+                # session re-establishment re-registers the same SCALED
+                # shape (historically this was the 2x-per-node storm)
+                total += 4          # hnew, svc9, svc9/lb0, svc1/lbnew
+                dirs |= {"/com/foo/svc9"}
+                server.expire_session(client._session_id)
+                assert await wait_for(
+                    lambda: client.is_connected()
+                    and client._session_id != sid)
+                sid = client._session_id
+                assert await wait_for(
+                    lambda: len(mine(state.data_watches)) == total)
+                assert await wait_for(
+                    lambda: mine(state.child_watches) == dirs)
+                assert len(cache.nodes) == total
+            finally:
+                if client is not None:
+                    client.close()
+                writer.close()
+                await server.stop()
+
+        asyncio.run(run())
+
+
 class TestScaleAwareBackpressure:
     def test_precompile_bound_scales_with_zone(self):
         store = FakeStore()

@@ -92,7 +92,10 @@ async def _run(duration: float) -> dict:
         collector=collector, recorder=recorder)
     await recursion.wait_ready()
 
-    max_staleness = duration * 0.08
+    # floored: at the short durations the test harness uses, a purely
+    # proportional cap makes the fresh->stale->exhausted windows so
+    # narrow that scheduler jitter alone can skip a mode entirely
+    max_staleness = max(0.6, duration * 0.08)
     server = BinderServer(
         zk_cache=cache, dns_domain=DOMAIN, datacenter_name="dc0",
         host="127.0.0.1", port=0, collector=collector, query_log=False,

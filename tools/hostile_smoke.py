@@ -1,0 +1,285 @@
+#!/usr/bin/env python3
+"""hostile-smoke: the hostile-traffic plane end to end, as a CI gate.
+
+Boots a real server process (``binder_tpu.main`` with a fake-store
+fixture and a deliberately low RRL limit), measures a no-flood legit
+goodput control, then runs the adversarial multi-flow harness
+(``tools/hostile.py``) against it — spoofed-source flood from hostile
+prefixes, malformed/EDNS/oversized frames, cache-missing random names,
+realistic queries — while the same paced legit client measures goodput
+*under* the flood.  Asserts the hostile-internet invariants:
+
+- **RRL engaged**: the spoof prefixes see slips (TC=1 echoes) and
+  silent drops; ``binder_rrl_dropped_total`` and
+  ``binder_shed_total{reason="response-ratelimit"}`` moved.
+- **Legit goodput survives**: the paced 127.0.0.1 client (its own
+  /24, under the per-prefix limit) keeps a goodput ratio vs the
+  no-flood control above the smoke floor.  The bench's ``hostile``
+  axis records the real number; this gate only refuses regressions
+  to "flood starves everyone".
+- **Fuzz-clean**: malformed frames produce FORMERR-or-drop (never a
+  served answer), and the server process stays up throughout.
+- **Bounded state**: server RSS growth over the soak stays bounded
+  (the RRL bucket LRU + prefix cache must not grow with source
+  diversity), and ``binder_rrl_buckets`` respects ``maxBuckets``.
+- **Observability**: the ``binder_rrl_*`` exposition validates
+  (``tools/lint.py validate_rrl_metrics``) and ``/status`` carries
+  the ``policy.rrl`` section.
+
+``BINDER_HOSTILE_SECONDS`` overrides the flood duration (default 30;
+``make ci`` trims to 10).  Prints one JSON summary line; exit 0 ==
+all held.  Run via ``make hostile-smoke``.
+"""
+import json
+import os
+import re
+import select
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.hostile import legit_probe  # noqa: E402
+from tools.lint import (validate_rrl_metrics,  # noqa: E402
+                        validate_status_snapshot)
+
+DOMAIN = "smoke.test"
+DURATION = float(os.environ.get("BINDER_HOSTILE_SECONDS", "30"))
+#: paced legit offered load — must sit under RRL_RPS (see below) so
+#: the probe measures the flood's collateral damage, not its own shed
+LEGIT_QPS = 100
+#: RRL config for the smoke server: low enough that the spoof flood
+#: (hundreds-to-thousands of rps per hostile /24) trips it within the
+#: first second, high enough that the paced legit client never does
+RRL_RPS, RRL_BURST, RRL_MAX_BUCKETS = 150, 300, 512
+#: flood pacing: the smoke asserts the *policy* sheds the flood, so
+#: the offered load is paced to what one Python server keeps up with —
+#: kernel socket-buffer overflow shedding legit traffic alongside the
+#: flood would measure capacity, not the limiter
+FLOOD_QPS = 6000
+FLOOD_FLOWS = 64
+#: RSS growth bound over the soak; the bucket LRU (512 entries) and
+#: prefix cache are the only per-flood state, orders of magnitude less
+MAX_RSS_GROWTH_KB = 64 * 1024
+#: smoke floor for goodput-under-flood vs control (the bench axis
+#: records the real ratio; ISSUE 12's target there is >= 0.8)
+GOODPUT_FLOOR = 0.5
+
+
+class Violation(Exception):
+    pass
+
+
+def _write_configs(tmpdir):
+    fixture = {f"/test/smoke/w{i}":
+               {"type": "host", "host": {"address": f"10.9.0.{i + 1}"}}
+               for i in range(8)}
+    fixture_path = os.path.join(tmpdir, "fixture.json")
+    with open(fixture_path, "w") as f:
+        json.dump(fixture, f)
+    config_path = os.path.join(tmpdir, "config.json")
+    with open(config_path, "w") as f:
+        json.dump({
+            "dnsDomain": DOMAIN, "datacenterName": "dc0",
+            "host": "127.0.0.1",
+            "store": {"backend": "fake", "fixture": fixture_path},
+            "queryLog": False,
+            "rrl": {"responsesPerSecond": RRL_RPS, "burst": RRL_BURST,
+                    "slipRatio": 2, "maxBuckets": RRL_MAX_BUCKETS},
+        }, f)
+    return config_path
+
+
+def _wait_for_ports(proc, timeout=30.0):
+    deadline = time.time() + timeout
+    buf = b""
+    while time.time() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(0.0, deadline - time.time()))
+        if not ready:
+            break
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            raise Violation("server exited during startup")
+        buf += chunk
+        m = re.search(rb"UDP DNS service started on [\d.]+:(\d+)\"", buf)
+        if m:
+            mm = re.search(rb"metrics server started on port (\d+)\"", buf)
+            if mm is None:
+                raise Violation("server did not report a metrics port")
+            return int(m.group(1)), int(mm.group(1))
+    raise Violation("server did not report its port in time")
+
+
+def _rss_kb(pid):
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+def _scrape(mport, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def _metric(text, name):
+    total = 0.0
+    for m in re.finditer(rf"^{name}(?:{{[^}}]*}})? ([0-9.eE+-]+)$",
+                         text, re.M):
+        total += float(m.group(1))
+    return total
+
+
+def _run():
+    tmpdir = tempfile.mkdtemp(prefix="hostile_smoke_")
+    config = _write_configs(tmpdir)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    server = subprocess.Popen(
+        [sys.executable, "-u", "-m", "binder_tpu.main", "-f", config,
+         "-p", "0"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    flood = None
+    try:
+        port, mport = _wait_for_ports(server)
+
+        # 1. no-flood control: paced legit goodput
+        control = legit_probe("127.0.0.1", port,
+                              duration=max(2.0, DURATION * 0.1),
+                              domain=DOMAIN, qps=LEGIT_QPS)
+        if not control["answered"]:
+            raise Violation(f"control probe got no answers ({control})")
+
+        rss_before = _rss_kb(server.pid)
+
+        # 2. the flood (separate process: the harness must not share
+        # the probe's GIL) + the same paced probe under it
+        flood = subprocess.Popen(
+            [sys.executable, "-u",
+             os.path.join(ROOT, "tools", "hostile.py"),
+             "--port", str(port), "--duration", str(DURATION),
+             "--flows", str(FLOOD_FLOWS), "--qps", str(FLOOD_QPS),
+             "--domain", DOMAIN],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        time.sleep(0.5)   # let the flood trip the limiter first
+        under = legit_probe("127.0.0.1", port,
+                            duration=max(1.0, DURATION - 1.5),
+                            domain=DOMAIN, qps=LEGIT_QPS)
+        out, _ = flood.communicate(timeout=DURATION + 30)
+        if flood.returncode != 0:
+            raise Violation(f"hostile harness exited {flood.returncode}")
+        report = json.loads(out)
+
+        if server.poll() is not None:
+            raise Violation("server died under hostile load")
+        rss_after = _rss_kb(server.pid)
+
+        # 3. RRL engaged: the spoof prefixes got slipped/dropped
+        spoof = report["categories"]["spoof"]
+        if not (spoof["slipped"] or spoof["dropped"]):
+            raise Violation(f"spoof flood was never rate-limited ({spoof})")
+        if not spoof["slipped"]:
+            raise Violation("no TC=1 slips observed (slipRatio=2 config)")
+
+        # 4. fuzz-clean: malformed traffic is FORMERR-or-drop, never
+        # a served answer (tiny tolerance for qid-collision
+        # misattribution across categories sharing a flow)
+        malformed = report["categories"]["malformed"]
+        if malformed["sent"] and (malformed["answered"]
+                                  > 0.02 * malformed["sent"] + 3):
+            raise Violation(f"malformed frames got answers ({malformed})")
+
+        # 5. legit goodput under flood vs control
+        ratio = (under["qps"] / control["qps"]) if control["qps"] else 0.0
+        if ratio < GOODPUT_FLOOR:
+            raise Violation(
+                f"legit goodput collapsed under flood: {under['qps']} "
+                f"vs control {control['qps']} qps (ratio {ratio:.2f})")
+
+        # 6. bounded state: RSS growth and the bucket cap
+        if (rss_before is not None and rss_after is not None
+                and rss_after - rss_before > MAX_RSS_GROWTH_KB):
+            raise Violation(f"server RSS grew {rss_after - rss_before} kB "
+                            f"over the soak (cap {MAX_RSS_GROWTH_KB})")
+
+        # 7. observability: exposition + /status schema + shed series
+        text = _scrape(mport, "/metrics")
+        errs = validate_rrl_metrics(text)
+        if errs:
+            raise Violation(f"rrl metrics: {errs[:3]}")
+        if _metric(text, "binder_rrl_dropped_total") <= 0:
+            raise Violation("binder_rrl_dropped_total never moved")
+        if _metric(text, "binder_rrl_buckets") > RRL_MAX_BUCKETS:
+            raise Violation("binder_rrl_buckets exceeds maxBuckets")
+        status = json.loads(_scrape(mport, "/status"))
+        errs = validate_status_snapshot(status)
+        if errs:
+            raise Violation(f"status snapshot: {errs[:3]}")
+        rrl_status = (status.get("policy") or {}).get("rrl")
+        if not rrl_status or not rrl_status.get("dropped"):
+            raise Violation(f"/status policy.rrl missing or idle "
+                            f"({rrl_status})")
+
+        # 8. post-flood health: the server answers normally again
+        after = legit_probe("127.0.0.1", port, duration=1.0,
+                            domain=DOMAIN, qps=50)
+        if not after["answered"]:
+            raise Violation("server unhealthy after the flood")
+
+        return {
+            "duration_s": DURATION,
+            "control_qps": control["qps"],
+            "under_flood_qps": under["qps"],
+            "goodput_ratio": round(ratio, 3),
+            "under_flood": under,
+            "hostile_qps": report["hostile_qps"],
+            "flows": report["flows"],
+            "spoof": spoof,
+            "malformed": malformed,
+            "rss_growth_kb": (rss_after - rss_before
+                              if rss_before and rss_after else None),
+            "rrl": {"dropped": _metric(text, "binder_rrl_dropped_total"),
+                    "slipped": _metric(text, "binder_rrl_slipped_total"),
+                    "responses": _metric(text,
+                                         "binder_rrl_responses_total"),
+                    "buckets": _metric(text, "binder_rrl_buckets")},
+        }
+    finally:
+        for proc in (flood, server):
+            if proc is None:
+                continue
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                except Exception:
+                    pass
+
+
+def main() -> int:
+    try:
+        stats = _run()
+    except Violation as e:
+        print(json.dumps({"hostile_smoke": "FAIL", "violation": str(e)}))
+        return 1
+    print(json.dumps({"hostile_smoke": "ok", **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -583,7 +583,7 @@ def validate_status_snapshot(snap):
                 errs.append(f"precompile: missing {key!r}")
     pol = snap.get("policy")
     if isinstance(pol, dict):
-        for key in ("degradation", "admission", "breakers_open"):
+        for key in ("degradation", "admission", "rrl", "breakers_open"):
             if key not in pol:
                 errs.append(f"policy: missing {key!r}")
         deg = pol.get("degradation")
@@ -605,6 +605,13 @@ def validate_status_snapshot(snap):
                         "recursion_burst", "clients_tracked", "shed"):
                 if key not in adm:
                     errs.append(f"policy.admission: missing {key!r}")
+        rrl = pol.get("rrl")
+        if isinstance(rrl, dict):
+            for key in ("enabled", "responses_per_second", "burst",
+                        "slip_ratio", "buckets", "hot", "responses",
+                        "slipped", "dropped", "evictions"):
+                if key not in rrl:
+                    errs.append(f"policy.rrl: missing {key!r}")
     return errs
 
 
@@ -965,6 +972,70 @@ def validate_federation_metrics(text):
                     errs.append(f"{family}: unexpected label(s) "
                                 f"{sorted(stray)}")
                     break
+    return errs
+
+
+# -- RRL / hostile-traffic metrics (ISSUE 12, docs/operations.md) -----
+#
+# The hostile-internet posture is told by the binder_rrl_* family
+# (responses admitted / slipped / dropped / bucket evictions, live
+# bucket count, the `active` flood flag) plus the
+# binder_shed_total{reason="response-ratelimit"} series the drops feed.
+# Wired into tier-1 via tests/test_hostile.py and into
+# `make hostile-smoke`.
+
+_RRL_FAMILIES = {
+    "binder_rrl_responses_total": "counter",
+    "binder_rrl_slipped_total": "counter",
+    "binder_rrl_dropped_total": "counter",
+    "binder_rrl_evictions_total": "counter",
+    "binder_rrl_buckets": "gauge",
+    "binder_rrl_active": "gauge",
+}
+
+
+def validate_rrl_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_rrl_*`` family plus the response-ratelimit shed series:
+    correct TYPE declarations, at least one sample each, and no labels
+    beyond the collector's static set.  Returns error strings;
+    empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            name, _, labels = parts[0].partition("{")
+            samples.setdefault(name, []).append(labels)
+    for family, kind in _RRL_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in samples:
+            errs.append(f"{family}: no samples in exposition")
+            continue
+        for labels in samples[family]:
+            names = {pair.partition("=")[0]
+                     for pair in labels.partition("}")[0].split(",")
+                     if pair}
+            stray = names - _MIRROR_ALLOWED_LABELS
+            if stray:
+                errs.append(f"{family}: unexpected label(s) "
+                            f"{sorted(stray)}")
+                break
+    # the drop path must surface in the shared shed accounting too:
+    # operators alert on binder_shed_total, not per-family counters
+    if not any(parts and parts[0].startswith("binder_shed_total{")
+               and 'reason="response-ratelimit"' in parts[0]
+               for parts in (ln.split() for ln in text.splitlines())
+               if parts and not parts[0].startswith("#")):
+        errs.append('binder_shed_total: missing the '
+                    'reason="response-ratelimit" series')
     return errs
 
 
