@@ -2277,6 +2277,138 @@ def _bench_hostile(tmpdir: str) -> Dict[str, object]:
         _reap(proc)
 
 
+VERIFY_ZONES = os.environ.get("BENCH_VERIFY_ZONES", "10000,1000000")
+N_VERIFY_MUTATIONS = int(os.environ.get("BENCH_VERIFY_MUTATIONS", "400"))
+
+_VERIFY_LINE = re.compile(
+    r'^binder_verify_(checks|violations|skipped)_total'
+    r'\{[^}]*invariant="([^"]+)"[^}]*\} ([0-9.eE+-]+)$', re.M)
+
+
+def _scrape_verify(metrics_port: int) -> Dict[str, Dict[str, float]]:
+    """The `binder_verify_*` counters off a live scrape — proof the ON
+    side of the verify A/B was actually checking (checks advancing)
+    and that the zone it checked was clean (violations zero)."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    out: Dict[str, Dict[str, float]] = {
+        "checks": {}, "violations": {}, "skipped": {}}
+    for kind, inv, value in _VERIFY_LINE.findall(text):
+        v = float(value)
+        if v:
+            out[kind][inv] = out[kind].get(inv, 0.0) + v
+    return out
+
+
+def _bench_verify(tmpdir: str) -> Dict[str, object]:
+    """Verify axis (ISSUE 16), two halves.  (a) Mutation→glass
+    propagation p50/p99 per stage at each BENCH_VERIFY_ZONES size —
+    one tools/verify_probe.py subprocess per size (RSS isolation, the
+    zone_scale discipline) records the tracer's per-stage figures
+    (end-to-end from the store event, what binder_propagation_seconds
+    sees), the checker's inline worst-case mutation cost, and one full
+    audit pass with its worst slice; flat glass-latency from the
+    smallest size to 1M is the O(delta) acceptance.  (b) The
+    headline-qps cost of running the verify plane at all: two
+    identical servers, one with the subsystem ON (the production
+    default — incremental checker + 4 Hz audit + tracer) and one with
+    `verify.enabled: false`, driven in interleaved A-B-A-B passes
+    inside one window so box drift cancels out of the estimate (the
+    balancer-overhead discipline) — acceptance: overhead <= 1%."""
+    sizes = [int(s) for s in VERIFY_ZONES.split(",") if s.strip()]
+    per_size: Dict[str, dict] = {}
+    for n in sizes:
+        o = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "verify_probe.py"),
+             str(n), str(N_VERIFY_MUTATIONS)],
+            capture_output=True, text=True, check=True,
+            timeout=600 + n // 1000)
+        per_size[str(n)] = json.loads(o.stdout)
+
+    fixture = os.path.join(tmpdir, "verify_fixture.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    base = {"dnsDomain": "bench.com", "datacenterName": "dc0",
+            "host": "127.0.0.1", "queryLog": False,
+            "store": {"backend": "fake", "fixture": fixture}}
+    on_cfg = os.path.join(tmpdir, "verify_on.json")
+    with open(on_cfg, "w") as f:
+        json.dump({**base, "verify": {}}, f)
+    off_cfg = os.path.join(tmpdir, "verify_off.json")
+    with open(off_cfg, "w") as f:
+        json.dump({**base, "verify": {"enabled": False}}, f)
+    rounds = max(3, N_PASSES)
+    procs: List[subprocess.Popen] = []
+    try:
+        on = _launch_server(on_cfg)
+        procs.append(on)
+        on_port, on_mport = wait_for_ports(on)
+        off = _launch_server(off_cfg)
+        procs.append(off)
+        off_port = wait_for_port(off)
+
+        _drive_native(on_port, tmpdir)    # warm both sides
+        _drive_native(off_port, tmpdir)
+        on_passes: List[Dict[str, float]] = []
+        off_passes: List[Dict[str, float]] = []
+        for _ in range(rounds):
+            on_passes.append(_drive_native(on_port, tmpdir))
+            off_passes.append(_drive_native(off_port, tmpdir))
+
+        def med(passes):
+            passes = sorted(passes, key=lambda r: r["qps"])
+            r = dict(passes[len(passes) // 2])
+            r["qps_spread"] = round(
+                passes[-1]["qps"] - passes[0]["qps"], 1)
+            return r
+
+        on_res, off_res = med(on_passes), med(off_passes)
+        scrape = None
+        try:
+            scrape = _scrape_verify(on_mport)
+        except OSError as e:
+            print(f"bench: verify scrape failed: {e!r}",
+                  file=sys.stderr)
+    finally:
+        for p in procs:
+            _reap(p)
+
+    largest = per_size[str(sizes[-1])]
+    smallest = per_size[str(sizes[0])]
+
+    def glass(entry, pct):
+        s = entry.get("propagation", {}).get("compiled-install")
+        return s.get(pct) if s else None
+
+    g_small, g_large = glass(smallest, "p50_us"), glass(largest, "p50_us")
+    live_violations = sum(
+        (scrape or {}).get("violations", {}).values())
+    return {
+        "sizes": sizes,
+        "per_size": per_size,
+        # the acceptance headlines, precomputed so the JSON answers
+        # them without arithmetic
+        "on_qps": round(on_res["qps"], 1),
+        "on_qps_spread": on_res["qps_spread"],
+        "off_qps": round(off_res["qps"], 1),
+        "off_qps_spread": off_res["qps_spread"],
+        "overhead_pct": round(
+            (1.0 - on_res["qps"] / off_res["qps"]) * 100.0, 1),
+        "passes": rounds,
+        "glass_p50_us_largest": g_large,
+        "glass_p99_us_largest": glass(largest, "p99_us"),
+        "glass_flatness": round(g_large / g_small, 2)
+        if g_large and g_small else None,
+        "audit_worst_slice_ms_largest":
+            largest["audit_worst_slice_ms"],
+        "violations": sum(e["violations"] for e in per_size.values())
+        + int(live_violations),
+        "verify_scrape": scrape,
+    }
+
+
 def _try_axis(name: str, fn, retries: int = 1):
     """Run one bench axis, retrying once on failure: every axis is
     exception-guarded so a transient (a busy box stretching a startup
@@ -2296,7 +2428,7 @@ def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
     realistic = degraded = shard = zone_scale = cross_dc = None
-    hostile = None
+    hostile = verify_ax = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -2327,6 +2459,8 @@ def run_bench() -> Dict[str, object]:
                                  lambda: _bench_cross_dc(tmpdir))
             hostile = _try_axis("hostile",
                                 lambda: _bench_hostile(tmpdir))
+            verify_ax = _try_axis("verify",
+                                  lambda: _bench_verify(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -2567,6 +2701,14 @@ def run_bench() -> Dict[str, object]:
         env["hostile_flows"] = hostile["flows"]
         env["hostile_mix"] = hostile["mix"]
         env["hostile_offered_qps"] = HOSTILE_QPS
+    if verify_ax is not None:
+        # verify axis (ISSUE 16): mutation→glass per-stage p50/p99 at
+        # each zone size (flat = O(delta)), the checker's inline
+        # worst-case mutation cost, one full audit pass per size, and
+        # the interleaved A/B headline cost of the verify plane —
+        # overhead_pct is the acceptance figure (<= 1%), violations
+        # must be 0 on the uncorrupted bench zones
+        out["verify"] = verify_ax
     if topo is not None:
         # supplementary: deployment shape (balancer + 2 backends), warm,
         # with the balancer's own per-stage attribution riding along

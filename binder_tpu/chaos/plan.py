@@ -21,6 +21,9 @@ DSL — one action per line (``;`` also separates), ``#`` comments::
     at 4.0  expire-session          # loss + immediate re-establish
     at 4.5  shard-kill shard=0      # SIGKILL a serving shard worker
     at 5.0  restore-session         # plain re-establish
+    at 5.2  corrupt-answer          # flip a byte in a compiled wire
+    at 5.4  drop-reverse            # delete one PTR map entry
+    at 5.6  skew-replica shard=0    # suppress one worker delta frame
     at 6.0  upstream clear          # all upstream faults off
 
 Actions
@@ -51,6 +54,15 @@ Actions
   acceptance invariant is the supervisor's: the kernel re-hashes the
   dead socket's share to the survivors at once, and the respawned
   worker catches up from snapshot (binder_tpu/shard).
+- ``corrupt-answer [qname=...]`` / ``drop-reverse [ip=...]`` /
+  ``skew-replica [shard=I] [frames=N]`` — verify-plane faults (ISSUE
+  16), dispatched by method name at the driver's ``verify_target``
+  (the :class:`BinderServer` for the table corruptions, the shard
+  supervisor for the mutation-log skew).  Each breaks serving state
+  WITHOUT firing an invalidation — the sampled audit (compiled-bytes,
+  ptr-coherence) and the digest frames (replica-digest) are the only
+  things that can catch them, which is the point: the chaos action
+  proves the checker's detection, not the datapath's tolerance.
 
 Determinism: the plan carries its own seeded RNG; two runs with the
 same seed inject byte-identical fault decisions.
@@ -66,8 +78,11 @@ from typing import Callable, List, Optional, Tuple
 ACTIONS = ("lose-session", "restore-session", "expire-session",
            "watch-storm", "loop-stall", "upstream",
            "tcp-slow-reader", "tcp-half-close", "tcp-rst",
-           "shard-kill")
+           "shard-kill",
+           "corrupt-answer", "drop-reverse", "skew-replica")
 STREAM_ACTIONS = ("tcp-slow-reader", "tcp-half-close", "tcp-rst")
+#: verify-plane faults, dispatched by method name at ``verify_target``
+VERIFY_ACTIONS = ("corrupt-answer", "drop-reverse", "skew-replica")
 
 
 class UpstreamFaults:
@@ -154,7 +169,12 @@ class FaultPlan:
                 try:
                     kwargs[k] = float(v) if "." in v else int(v)
                 except ValueError:
-                    raise ValueError(f"chaos spec: bad value {tok!r}")
+                    # non-numeric values are strings (verify-plane
+                    # selectors: qname=..., ip=...); empty is still
+                    # malformed
+                    if not v:
+                        raise ValueError(f"chaos spec: bad value {tok!r}")
+                    kwargs[k] = v
             plan.at(t, action, **kwargs)
         return plan
 
@@ -174,6 +194,7 @@ class ChaosDriver:
                  mutate: Optional[Callable[[int], None]] = None,
                  tcp_target: Optional[Tuple[str, int, str]] = None,
                  shard_target: Optional[Callable[[int], object]] = None,
+                 verify_target=None,
                  recorder=None,
                  log: Optional[logging.Logger] = None) -> None:
         self.plan = plan
@@ -186,6 +207,10 @@ class ChaosDriver:
         # shard-kill sink: the supervisor's kill_shard(index) (index -1
         # = random live worker); None skips with a warning
         self.shard_target = shard_target
+        # verify-plane fault sink: corrupt_answer/drop_reverse on a
+        # BinderServer, skew_replica on a shard supervisor — dispatch
+        # is by method name, so either (or a test double) fits
+        self.verify_target = verify_target
         self.recorder = recorder
         self.log = log or logging.getLogger("binder.chaos")
         self.applied: List[Tuple[float, str]] = []
@@ -220,6 +245,8 @@ class ChaosDriver:
                 self.shard_target(int(kwargs.get("shard", -1)))
         elif action in STREAM_ACTIONS:
             self._stream_action(action, kwargs)
+        elif action in VERIFY_ACTIONS:
+            self._verify_action(action, kwargs)
         else:
             raise ValueError(f"unknown chaos action {action!r}")
         self.applied.append((time.monotonic(), action))
@@ -250,6 +277,24 @@ class ChaosDriver:
                              type(st).__name__, action)
             return
         fn()
+
+    def _verify_action(self, action: str, kwargs: dict) -> None:
+        vt = self.verify_target
+        if vt is None:
+            self.log.warning("chaos: %s with no verify target; skipped",
+                             action)
+            return
+        fn = getattr(vt, action.replace("-", "_"), None)
+        if fn is None:
+            self.log.warning("chaos: verify target %s has no hook "
+                             "for %s", type(vt).__name__, action)
+            return
+        result = fn(**kwargs)
+        if result is None:
+            # nothing to corrupt (empty table / no matching entry):
+            # loud, so a smoke that asserted a detection can tell
+            # "not injected" apart from "not detected"
+            self.log.warning("chaos: %s found no target state", action)
 
     def _stream_action(self, action: str, kwargs: dict) -> None:
         if self.tcp_target is None:

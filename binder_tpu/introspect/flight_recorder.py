@@ -31,6 +31,7 @@ EVENT_TYPES = (
     "slow-query",           # query latency over SLOW_QUERY_MS
     "resolver-error",       # query handler raised (engine error path)
     "loop-stall",           # event-loop lag over the watchdog threshold
+    "verify-violation",     # serving-plane invariant check failed
     "dump",                 # a SIGUSR2/explicit dump was taken
 )
 

@@ -136,6 +136,7 @@ class Introspector:
             "recursion": self._recursion_section(),
             "federation": self._federation_section(),
             "precompile": self._precompile_section(),
+            "verify": self._verify_section(),
             "policy": self._policy_section(),
             "loop": (self.watchdog.snapshot()
                      if self.watchdog is not None else None),
@@ -149,6 +150,15 @@ class Introspector:
         pc = getattr(self.server, "_precompiler", None) \
             if self.server is not None else None
         return None if pc is None else pc.introspect()
+
+    def _verify_section(self) -> Optional[dict]:
+        """Serving-plane verification state (null when the feature is
+        off): per-invariant check/violation/skip counts, the recent
+        violations table, audit progress, and the mutation-to-glass
+        propagation stage latencies (docs/observability.md)."""
+        vf = getattr(self.server, "_verify", None) \
+            if self.server is not None else None
+        return None if vf is None else vf.introspect()
 
     def _store_section(self) -> dict:
         st = self.store

@@ -117,6 +117,11 @@ async def run_supervisor(options: Dict[str, object]):
     supervisor = ShardSupervisor(options=options, store=store,
                                  cache=cache, collector=collector,
                                  recorder=recorder, log=log, name=NAME)
+    # arm /status before start(): the canonical announce line prints
+    # inside start() once the whole group serves, and a harness may
+    # poll the snapshot the instant it sees that line (the metrics
+    # server thread answers concurrently with the lines below)
+    metrics.status_source = supervisor.snapshot
     await supervisor.start()
 
     loop = asyncio.get_running_loop()
@@ -159,6 +164,10 @@ async def run_supervisor(options: Dict[str, object]):
             tcp_target=(chaos_host, supervisor.tcp_port,
                         f"chaos0.{domain}"),
             shard_target=supervisor.kill_shard,
+            # skew-replica desyncs one worker's mutation log (the
+            # digest frames must catch it); the supervisor owns the
+            # per-link streams
+            verify_target=supervisor,
             recorder=recorder, log=log)
         supervisor.chaos_driver = driver
         driver.start()
@@ -167,7 +176,6 @@ async def run_supervisor(options: Dict[str, object]):
 
     watchdog = LoopLagWatchdog(collector=collector, recorder=recorder)
     watchdog.start()
-    metrics.status_source = supervisor.snapshot
     recorder.install_sigusr2(loop, path=options.get("flightRecorderDump"))
     supervisor.watchdog = watchdog
     supervisor.metrics = metrics
@@ -324,6 +332,10 @@ async def run(options: Dict[str, object]) -> BinderServer:
         # response rate limiting at the UDP ingress (hostile-internet
         # posture, docs/operations.md): same on-by-default convention
         rrl=dict(options.get("rrl") or {}),
+        # serving-plane verification + propagation tracing
+        # (docs/observability.md): on by default like the other
+        # production observability
+        verify=dict(options.get("verify") or {}),
         # shard workers share ONE port via SO_REUSEPORT (the kernel
         # balances) and leave the canonical announce lines to the
         # supervisor, which prints them once the whole group serves
@@ -379,6 +391,9 @@ async def run(options: Dict[str, object]) -> BinderServer:
             # tcp-rst) drive the server's own TCP listener
             tcp_target=(chaos_host, server.tcp_port,
                         f"chaos0.{domain}"),
+            # verify-plane corruption (corrupt-answer / drop-reverse)
+            # mutates the server's own tables behind the checker's back
+            verify_target=server,
             recorder=recorder, log=log)
         server.chaos_driver = driver
         driver.start()
@@ -430,6 +445,13 @@ def _wire_shard_worker(server: BinderServer, store, metrics, collector,
         os._exit(1)
 
     store.on_link_down = link_down
+    verify = getattr(server, "_verify", None)
+    if verify is not None:
+        # replica-parity wiring: delta-frame trace contexts feed the
+        # worker's propagation tracer, digest comparisons feed its
+        # replica-digest counters (the supervisor counts its own half)
+        store.tracer = verify.tracer
+        store.on_digest = verify.note_digest
     store.start(loop)
     store.send(protocol.hello_frame(
         shard, os.getpid(), server.udp_port, server.tcp_port,

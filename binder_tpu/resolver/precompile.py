@@ -109,7 +109,7 @@ class Precompiler:
     def __init__(self, *, resolver, answer_cache, zk_cache, summarize,
                  collector=None, recorder=None,
                  log: Optional[logging.Logger] = None,
-                 native_put=None) -> None:
+                 native_put=None, tracer=None) -> None:
         self.resolver = resolver
         self.answer_cache = answer_cache
         self.zk_cache = zk_cache
@@ -122,6 +122,11 @@ class Precompiler:
         self.native_put = native_put
         self.recorder = recorder
         self.log = log or logging.getLogger("binder.precompile")
+        # optional propagation tracer (binder_tpu/verify): each queued
+        # item remembers the mutation trace context that enqueued it,
+        # so the async re-render reports against the mutation's t0
+        self.tracer = tracer
+        self._pending_trace: dict = {}
         # insertion-ordered set of pending items (dict keys)
         self._pending: dict = {}
         self._drain_scheduled = False
@@ -220,17 +225,23 @@ class Precompiler:
         pending = self._pending
         room = self._max_pending() - len(pending)
         shed = 0
+        tracer = self.tracer
+        ctx = tracer.current if tracer is not None else None
         for qtype, qname, evidence_at in items:
             key = (qtype, qname)
             have = pending.get(key)
             if have is not None:
                 if evidence_at > have:
                     pending[key] = evidence_at
+                if ctx is not None:
+                    self._pending_trace[key] = ctx
                 continue                # coalesced
             if room <= 0:
                 shed += 1
                 continue
             pending[key] = evidence_at
+            if ctx is not None:
+                self._pending_trace[key] = ctx
             room -= 1
         if shed:
             self._note_shed(shed)
@@ -267,24 +278,25 @@ class Precompiler:
             # no loop (synchronous setup paths, tests against the fake
             # store): compile inline — there is no serving loop to stall
             while self._pending:
-                item, ev = self._pop()
-                self._compile_one(item, evidence_at=ev)
+                item, ev, trace = self._pop()
+                self._compile_one(item, evidence_at=ev, trace=trace)
             return
         self._drain_scheduled = True
         loop.call_soon(self._drain)
 
     def _pop(self):
         item = next(iter(self._pending))
-        return item, self._pending.pop(item)
+        return (item, self._pending.pop(item),
+                self._pending_trace.pop(item, None))
 
     def _drain(self) -> None:
         self._drain_scheduled = False
         n = 0
         t0 = time.perf_counter()
         while self._pending and n < self.MAX_BATCH:
-            item, ev = self._pop()
+            item, ev, trace = self._pop()
             try:
-                self._compile_one(item, evidence_at=ev)
+                self._compile_one(item, evidence_at=ev, trace=trace)
             except Exception:  # noqa: BLE001 — see below
                 # precompilation is an optimization: a render bug must
                 # never break the mutation path that feeds it
@@ -365,42 +377,17 @@ class Precompiler:
         if self._m_declined is not None:
             self._m_declined.inc()
 
-    def _compile_one(self, item: Item, native: bool = False,
-                     evidence_at: Optional[float] = None) -> None:
-        """``native=True`` only on the startup seed: the C answer cache
-        is COLD there, so installing the whole mirror is pure win.  The
-        mutation path must NOT native-install — its sustained insert
-        stream would evict the resident hot set (the C cache evicts
-        oldest-inserted within a probe window), which measured as a
-        ~45%% churn-throughput collapse.  Post-churn names serve from
-        the Python compiled table immediately and re-enter the native
-        tier through the ordinary promote-on-first-hit path once they
-        prove hot.  ``evidence_at`` propagates the shape's query
-        evidence (see AnswerCache.put_compiled); None on the seed."""
-        qtype, qname = item
-        epoch = self.zk_cache.epoch
-        if qtype == Type.PTR:
-            plan = self.resolver.plan_ptr(qname)
-        else:
-            plan = self.resolver.plan(qname, qtype)
-        if plan.rcode == Rcode.SERVFAIL:
-            self._decline()             # never cache SERVFAIL
-            return
-        if plan.miss:
-            # nothing to serve: with recursion the answer is
-            # RD-dependent (REFUSED vs cross-DC forward) and only the
-            # lazy path may decide; without it, eagerly re-rendering
-            # REFUSED for every name that ever existed is unbounded
-            # churn amplification (the old-address PTR shape arrives
-            # here on EVERY rewrite).  Misses stay lazy — the per-key
-            # cache absorbs any repeat, as it always has.
-            self._decline()
-            return
+    def render_variants(self, qname: str, qtype: int, plan):
+        """The full rotation-variant set for *plan*: ``(w0, w1,
+        answers_summary, additionals_summary)`` per variant, in the
+        deterministic rotation order — or None when the set is
+        oversize or unencodable (those shapes stay lazy).  Shared with
+        the verify layer's compiled-bytes check, which re-renders and
+        compares byte-for-byte (``verify/checker.py``)."""
         groups = plan.groups
         if sum(len(g[0]) + len(g[1]) for g in groups) \
                 > self.MAX_SET_RECORDS:
-            self._decline()             # oversize answer set: lazy
-            return
+            return None                 # oversize answer set: lazy
         nv = min(len(groups), self.VARIANTS_CAP) if plan.rotatable else 1
         variants = []
         summarize = self.summarize
@@ -430,12 +417,55 @@ class Precompiler:
                     [summarize(r) for r in adds],
                 ))
         except WireError:
-            self._decline()             # unencodable store value: lazy
+            return None                 # unencodable store value: lazy
+        return variants
+
+    def _compile_one(self, item: Item, native: bool = False,
+                     evidence_at: Optional[float] = None,
+                     trace=None) -> None:
+        """``native=True`` only on the startup seed: the C answer cache
+        is COLD there, so installing the whole mirror is pure win.  The
+        mutation path must NOT native-install — its sustained insert
+        stream would evict the resident hot set (the C cache evicts
+        oldest-inserted within a probe window), which measured as a
+        ~45%% churn-throughput collapse.  Post-churn names serve from
+        the Python compiled table immediately and re-enter the native
+        tier through the ordinary promote-on-first-hit path once they
+        prove hot.  ``evidence_at`` propagates the shape's query
+        evidence (see AnswerCache.put_compiled); None on the seed.
+        ``trace`` is the enqueueing mutation's propagation-trace
+        context (verify/tracer.py), None outside the mutation path."""
+        qtype, qname = item
+        epoch = self.zk_cache.epoch
+        if qtype == Type.PTR:
+            plan = self.resolver.plan_ptr(qname)
+        else:
+            plan = self.resolver.plan(qname, qtype)
+        if plan.rcode == Rcode.SERVFAIL:
+            self._decline()             # never cache SERVFAIL
             return
+        if plan.miss:
+            # nothing to serve: with recursion the answer is
+            # RD-dependent (REFUSED vs cross-DC forward) and only the
+            # lazy path may decide; without it, eagerly re-rendering
+            # REFUSED for every name that ever existed is unbounded
+            # churn amplification (the old-address PTR shape arrives
+            # here on EVERY rewrite).  Misses stay lazy — the per-key
+            # cache absorbs any repeat, as it always has.
+            self._decline()
+            return
+        variants = self.render_variants(qname, qtype, plan)
+        if variants is None:
+            self._decline()
+            return
+        if trace is not None and self.tracer is not None:
+            self.tracer.observe("precompile-render", trace)
         tag = plan.dep_domain or qname
         self.answer_cache.put_compiled(
             qtype, qname, epoch, variants, rotatable=plan.rotatable,
             tag=tag, negative=plan.negative, evidence_at=evidence_at)
+        if trace is not None and self.tracer is not None:
+            self.tracer.observe("compiled-install", trace)
         if native and self.native_put is not None:
             self.native_put(qtype, qname, variants, tag, plan.rcode)
         self.compiled += 1

@@ -59,6 +59,7 @@ from binder_tpu.resolver.engine import (
 )
 from binder_tpu.utils.jsonlog import JsonFormatter, log_event
 from binder_tpu.utils.probes import ProbeProvider
+from binder_tpu.verify import Verifier
 
 METRIC_REQUEST_COUNTER = "binder_requests_completed"
 METRIC_LATENCY_HISTOGRAM = "binder_request_latency_seconds"
@@ -178,6 +179,7 @@ class BinderServer:
                  degradation: Optional[dict] = None,
                  admission: Optional[dict] = None,
                  rrl: Optional[dict] = None,
+                 verify: Optional[dict] = None,
                  reuse_port: bool = False,
                  announce: bool = True) -> None:
         self.log = log or logging.getLogger("binder.server")
@@ -330,6 +332,30 @@ class BinderServer:
         # introspector for the /status federation section
         self.federation = None
 
+        # Serving-plane verification (binder_tpu/verify, ISSUE 16):
+        # incremental invariant checks off the same per-name
+        # invalidation feed the precompiler drains, a sampled
+        # budgeted full-zone audit, and mutation-to-glass propagation
+        # tracing.  Same config convention as admission/rrl: None
+        # disables (direct construction / tests), a config block
+        # (even empty) enables with defaults.
+        self._verify: Optional[Verifier] = None
+        # trace contexts for names awaiting a zone re-push, popped by
+        # _zone_refresh to mark the native-install stage; bounded so a
+        # mutation storm on an unserved zone cannot grow it
+        self._zone_trace: dict = {}
+        if verify is not None and verify.get("enabled", True):
+            self._verify = Verifier(
+                zk_cache=zk_cache, answer_cache=self.answer_cache,
+                resolver=self.resolver,
+                policy_mode=(self._policy.mode
+                             if self._policy is not None else None),
+                config=verify, collector=self.collector,
+                recorder=flight_recorder, log=self.log)
+            # the mirror stamps each mutation's trace context at
+            # bump_gen and marks mirror-apply at invalidation fan-out
+            zk_cache.tracer = self._verify.tracer
+
         # Mutation-time answer precompilation (resolver/precompile.py):
         # store mutations eagerly re-render the affected names' answers
         # into the AnswerCache's compiled table, so post-churn (and
@@ -343,7 +369,13 @@ class BinderServer:
                 resolver=self.resolver, answer_cache=self.answer_cache,
                 zk_cache=zk_cache, summarize=self._summarize,
                 collector=self.collector, recorder=flight_recorder,
-                log=self.log, native_put=self._precompile_native_put)
+                log=self.log, native_put=self._precompile_native_put,
+                tracer=(self._verify.tracer
+                        if self._verify is not None else None))
+        if self._verify is not None:
+            # the checker re-renders through the precompiler for the
+            # compiled-bytes invariant (None: skip-counted, not silent)
+            self._verify.precompiler = self._precompiler
         self._precompile_serve_child = self.collector.counter(
             "binder_precompile_serves",
             "queries answered from mutation-time precompiled entries"
@@ -816,6 +848,18 @@ class BinderServer:
             # Only shapes with serving evidence (the dropped keys) are
             # re-rendered: churn on unqueried names costs nothing here.
             self._precompiler.enqueue(dropped)
+        if self._verify is not None:
+            # incremental verification rides the same feed (after the
+            # drops and re-render enqueue: the checker sees the
+            # post-mutation tables, never the stale ones)
+            self._verify.enqueue_tags(tags)
+            ctx = self._verify.tracer.current
+            if ctx is not None and self._zone_enabled:
+                zt = self._zone_trace
+                for tag in tags:
+                    zt[tag] = ctx
+                while len(zt) > self._ZONE_TRACE_CAP:
+                    del zt[next(iter(zt))]
         if self._zone_enabled:
             self._zone_dirty.update(tags)
             self._schedule_zone_drain()
@@ -823,6 +867,10 @@ class BinderServer:
     #: zone re-pushes drained per event-loop pass; bounds the refill
     #: work a mutation burst can inject between serving batches
     _ZONE_DRAIN_BATCH = 64
+
+    #: pending native-install trace contexts retained (oldest dropped
+    #: first — an evicted trace loses one stage sample, nothing else)
+    _ZONE_TRACE_CAP = 4096
 
     def _schedule_zone_drain(self) -> None:
         if self._zone_drain_pending or not self._zone_dirty:
@@ -857,6 +905,7 @@ class BinderServer:
         Stale entries were already dropped by tag invalidation; absent
         or ineligible names simply stay un-pushed and resolve through
         the raw lane / generic path."""
+        ctx = self._zone_trace.pop(name, None)
         try:
             if name.endswith(".in-addr.arpa") or name.endswith(".ip6.arpa"):
                 if name.endswith(".ip6.arpa"):
@@ -887,6 +936,53 @@ class BinderServer:
             # zone fill is an optimization: a push failure must never
             # break the mutation path that feeds it
             self.log.exception("zone push failed for %s", name)
+        if ctx is not None and self._verify is not None:
+            # the zone lane finished with this name — for a mutation's
+            # trace that is "the glass shows it" (even a now-ineligible
+            # name: its stale native entry is gone, which is the state
+            # the zone table should serve)
+            self._verify.tracer.observe("native-install", ctx)
+
+    # -- chaos injection hooks (chaos/plan.py corrupt-answer /
+    # drop-reverse; the driver dispatches on these method names) --
+
+    def corrupt_answer(self, qname: Optional[str] = None):
+        """Flip one byte mid-wire in a compiled-table entry's first
+        rotation variant.  Direct table corruption fires NO
+        invalidation — only the verify audit's compiled-bytes walk can
+        find it, which is exactly what the chaos action exists to
+        prove.  Returns the corrupted ``(qtype, qname)`` or None."""
+        for ckey, e in self.answer_cache._compiled.items():
+            if qname is not None and ckey[1] != qname:
+                continue
+            variants = e[2]
+            if not variants:
+                continue
+            v = variants[0]
+            if len(v[0]) <= 12:
+                continue                # header-only wire: nothing to flip
+            w0 = bytearray(v[0])
+            w0[len(w0) // 2] ^= 0xFF
+            variants[0] = (bytes(w0),) + tuple(v[1:])
+            self.log.warning("chaos: corrupted compiled answer for %s",
+                             ckey[1])
+            return ckey
+        return None
+
+    def drop_reverse(self, ip: Optional[str] = None):
+        """Delete one reverse-map entry without touching the forward
+        node — the forward/reverse coherence break the ptr-coherence
+        audit must catch (no invalidation fires here either).
+        Returns the dropped address or None."""
+        rl = self.zk_cache.rev_lookup
+        if ip is None:
+            ip = next(iter(rl), None)
+        if ip is None or ip not in rl:
+            return None
+        node = rl.pop(ip)
+        self.log.warning("chaos: dropped reverse entry %s -> %s",
+                         ip, getattr(node, "domain", "?"))
+        return ip
 
     def _zone_host_shape(self, node):
         """(record, sub, packed_addr, ttl) when `node` is a host-like
@@ -1756,10 +1852,13 @@ class BinderServer:
                               else wire[:12] + q_low + wire[q_end:])
                 # lane answers (hit, miss-REFUSED, suffix-REFUSED) all
                 # depend on exactly this name; the qname doubles as the
-                # dependency tag
+                # dependency tag.  qkey carries the question identity as
+                # re-render evidence — without it, churn on a name served
+                # only by this lane would never reach the precompiler
+                # (or the propagation tracer's render/install stages)
                 self.answer_cache.put(
                     key, epoch, (cache_wire, ans, []), rotatable=False,
-                    tag=name)
+                    tag=name, qkey=(qtype_val, name))
         except Exception:
             # response already sent: never fall through to the generic
             # path (it would answer a second time)
@@ -2118,8 +2217,12 @@ class BinderServer:
         if self._policy is not None and self._policy_task is None:
             self._policy_task = asyncio.get_running_loop().create_task(
                 self._policy_tick_loop())
+        if self._verify is not None:
+            self._verify.start(asyncio.get_running_loop())
 
     async def stop(self) -> None:
+        if self._verify is not None:
+            await self._verify.stop()
         if self._policy_task is not None:
             self._policy_task.cancel()
             try:

@@ -22,6 +22,7 @@ converges to the owner's view even for znodes whose bytes never parsed.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 from typing import List, Optional
 
@@ -62,14 +63,27 @@ def decode_frames(buf: bytearray) -> List[dict]:
     return out
 
 
-def node_frame(domain: str, data) -> dict:
+def node_frame(domain: str, data, tr: Optional[str] = None,
+               t0: Optional[float] = None) -> dict:
     """Upsert one mirrored name (data = the mirror's parsed JSON or
-    None for a data-less node)."""
-    return {"op": "node", "d": domain, "data": data}
+    None for a data-less node).  ``tr``/``t0`` optionally carry the
+    owner's propagation-trace id and monotonic origin instant
+    (CLOCK_MONOTONIC is machine-wide on Linux, so the replica's stage
+    timings land on the owner's timeline); older peers ignore them."""
+    f = {"op": "node", "d": domain, "data": data}
+    if tr is not None:
+        f["tr"] = tr
+        f["t0"] = t0
+    return f
 
 
-def gone_frame(domain: str) -> dict:
-    return {"op": "gone", "d": domain}
+def gone_frame(domain: str, tr: Optional[str] = None,
+               t0: Optional[float] = None) -> dict:
+    f = {"op": "gone", "d": domain}
+    if tr is not None:
+        f["tr"] = tr
+        f["t0"] = t0
+    return f
 
 
 def state_frame(state: str, connected: bool,
@@ -102,6 +116,45 @@ def stats_frame(requests: float, gen: int, epoch: int, ready: bool,
     return {"op": "stats", "requests": requests, "gen": gen,
             "epoch": epoch, "ready": ready, "inflight": inflight,
             "rrl_dropped": rrl_dropped, "shed": shed}
+
+
+def delta_digest(prev: str, frame: dict) -> str:
+    """Fold one delta frame into the rolling mutation-log digest.
+
+    Both ends of a shard link roll the same function over the same
+    ordered ``node``/``gone`` stream, starting from ``"0"`` at
+    ``snap-end`` (the stream is ordered, so the reset point aligns
+    even when deltas interleave with a snapshot in flight — unhashed
+    on both sides).  Only the replicated substance is hashed: op,
+    domain, canonicalized data.  Trace fields (``tr``/``t0``) are
+    deliberately excluded — they are observability freight, not
+    mirrored state, and older peers never see them at all."""
+    h = hashlib.sha256()
+    h.update(prev.encode("utf-8"))
+    h.update(str(frame.get("op")).encode("utf-8"))
+    h.update(b"\x00")
+    h.update(str(frame.get("d")).encode("utf-8"))
+    h.update(b"\x00")
+    h.update(json.dumps(frame.get("data"), sort_keys=True,
+                        separators=(",", ":")).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+def digest_frame(gen: int, digest: str) -> dict:
+    """Supervisor -> worker: the owner's rolling digest after the
+    delta batch for generation ``gen`` — the replica compares against
+    its own roll (cross-shard replica parity, ISSUE 16); older workers
+    warn-and-ignore the unknown op."""
+    return {"op": "digest", "gen": gen, "dg": digest}
+
+
+def digest_report_frame(shard: int, gen: int, ok: bool, have: str,
+                        want: str) -> dict:
+    """Worker -> supervisor: the outcome of a digest comparison
+    (mismatches only — the supervisor counts its own emitted frames as
+    checks)."""
+    return {"op": "digest-report", "shard": shard, "gen": gen,
+            "ok": ok, "have": have, "want": want}
 
 
 def snapshot_order(domains) -> List[str]:

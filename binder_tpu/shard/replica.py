@@ -72,6 +72,15 @@ class ReplicaStore(FakeStore):
         # no way back — its owner and mutation feed are gone
         self.on_link_down: Optional[Callable[[], None]] = None
         self._down = False
+        # replica-parity verification (ISSUE 16): the rolling delta
+        # digest — None until snap-end arms it (digests hash only
+        # post-snapshot deltas, on both ends) — plus the hooks the
+        # worker's verify layer wires up: `tracer` receives each delta
+        # frame's trace context, `on_digest(gen, ok, have, want)` the
+        # outcome of each digest comparison
+        self._dg: Optional[str] = None
+        self.tracer = None
+        self.on_digest: Optional[Callable] = None
 
     @classmethod
     def from_fd(cls, fd: int, shard: int, **kw) -> "ReplicaStore":
@@ -100,6 +109,9 @@ class ReplicaStore(FakeStore):
                 if frame.get("op") == "snap-end":
                     self.snapshot_nodes = int(frame.get("nodes", 0))
                     self._sock.settimeout(None)
+                    # arm the rolling delta digest: the supervisor
+                    # resets its per-link roll at the same stream point
+                    self._dg = "0"
                     return self.snapshot_nodes
                 self._apply(frame)
             if time.monotonic() > deadline:
@@ -171,21 +183,63 @@ class ReplicaStore(FakeStore):
 
     def _apply(self, frame: dict) -> None:
         op = frame.get("op")
-        if op == "node":
-            # intern the frame's domain: delta frames repeat the same
-            # hot names endlessly, and the pool makes each ONE object
-            # across the protocol, the replica tree, and the mirror
-            self._apply_node(intern_name(str(frame["d"])),
-                             frame.get("data"))
-        elif op == "gone":
-            self.rmr(domain_to_path(str(frame["d"])))
+        if op in ("node", "gone"):
+            if self._dg is not None:
+                self._dg = protocol.delta_digest(self._dg, frame)
+            tracer = self.tracer
+            if tracer is not None and "tr" in frame:
+                # stage the owner's trace context: the apply below
+                # fires bump_gen on the worker mirror, which consumes
+                # it — so the replica-side stages report against the
+                # owner's t0
+                tracer.inherit(frame.get("tr"), frame.get("t0"))
+            if op == "node":
+                # intern the frame's domain: delta frames repeat the
+                # same hot names endlessly, and the pool makes each ONE
+                # object across the protocol, the replica tree, and the
+                # mirror
+                self._apply_node(intern_name(str(frame["d"])),
+                                 frame.get("data"))
+            else:
+                self.rmr(domain_to_path(str(frame["d"])))
+            if tracer is not None:
+                tracer.observe("replica-apply")
+                tracer.clear()
         elif op == "state":
             self._apply_state(frame)
+        elif op == "digest":
+            self._check_digest(frame)
         else:
             self.log.warning("shard %d: unknown mutation-log op %r",
                              self.shard, op)
             return
         self.frames_applied += 1
+
+    def _check_digest(self, frame: dict) -> None:
+        """Compare the owner's rolling digest against ours; report
+        mismatches up-channel (replica-digest invariant).  A replica
+        that never finished a snapshot (or an older supervisor that
+        never sends digests) simply never compares."""
+        if self._dg is None:
+            return
+        want = str(frame.get("dg", ""))
+        gen = int(frame.get("gen", 0))
+        have = self._dg
+        ok = have == want
+        if not ok:
+            self.log.error(
+                "shard %d: replica digest mismatch at gen %d "
+                "(have %s want %s)", self.shard, gen, have, want)
+            self.send(protocol.digest_report_frame(
+                self.shard, gen, False, have, want))
+            # resync to the owner's roll: one detected divergence must
+            # not cascade into a mismatch per subsequent digest frame
+            self._dg = want
+        if self.on_digest is not None:
+            try:
+                self.on_digest(gen, ok, have, want)
+            except Exception:  # noqa: BLE001 — observer bug must not
+                self.log.exception("on_digest callback failed")
 
     def _apply_node(self, domain: str, data) -> None:
         path = domain_to_path(domain)

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 
 from binder_tpu.introspect.status import Introspector
 from binder_tpu.shard import protocol
+from binder_tpu.verify.tracer import PropagationTracer
 
 #: a worker whose stats are older than this is reported down
 #: (binder_shard_up 0) even if its PID still exists
@@ -69,7 +70,8 @@ class ShardLink:
                  "hello", "stats", "stats_at", "last_requests",
                  "last_rrl_dropped", "last_shed",
                  "spawned_mono", "rbuf", "closed",
-                 "snap_queue", "snap_sent", "snap_started")
+                 "snap_queue", "snap_sent", "snap_started",
+                 "dg", "skew_pending")
 
     def __init__(self, shard: int, proc: subprocess.Popen,
                  sock: socket.socket) -> None:
@@ -98,6 +100,12 @@ class ShardLink:
         self.snap_queue: Optional[object] = None
         self.snap_sent = 0
         self.snap_started = 0.0
+        # replica-parity digest (ISSUE 16): the owner-side rolling
+        # digest over this link's post-snapshot delta stream (None
+        # until snap-end), and the chaos `skew-replica` counter of
+        # deltas to hash-but-suppress (forcing a detectable mismatch)
+        self.dg: Optional[str] = None
+        self.skew_pending = 0
 
 
 class ShardSupervisor:
@@ -132,6 +140,24 @@ class ShardSupervisor:
         self._rng = random.Random()
         self.started_mono = time.monotonic()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # serving-plane verification (ISSUE 16): the owner-side
+        # propagation tracer (mutations are stamped here; workers
+        # inherit the context from the delta frames) and the
+        # supervisor half of the replica-digest invariant accounting
+        self.tracer = PropagationTracer(collector=collector, log=self.log)
+        cache.tracer = self.tracer
+        self.digest_checks = 0
+        self.digest_violations = 0
+        self._m_digest_checks = collector.counter(
+            "binder_verify_checks_total",
+            "serving-plane invariant checks evaluated").labelled(
+                {"invariant": "replica-digest"})
+        self._m_digest_violations = collector.counter(
+            "binder_verify_violations_total",
+            "serving-plane invariant violations detected").labelled(
+                {"invariant": "replica-digest"})
+        self._m_digest_checks.inc(0)
+        self._m_digest_violations.inc(0)
         self._register_metrics()
         # the owner mirror's per-name invalidation events ARE the
         # mutation log: every tag maps to a node upsert or removal
@@ -374,6 +400,10 @@ class ShardSupervisor:
             self._loop.call_soon(self._pump_snapshot, link)
             return
         link.snap_queue = None
+        # arm the per-link replica-parity digest at the same stream
+        # point the replica does (receiving snap-end): deltas that
+        # interleaved with the snapshot stayed unhashed on both ends
+        link.dg = "0"
         self._send(link, protocol.snap_end_frame(link.snap_sent))
 
     def _on_invalidate(self, tags) -> None:
@@ -385,19 +415,68 @@ class ShardSupervisor:
             return
         domain = self.cache.domain
         suffix = "." + domain
+        # propagation trace context: stamped by the owner mirror's
+        # bump_gen; the delta frames carry it so the workers' stages
+        # report against the owner's t0
+        ctx = self.tracer.current
+        tr, t0 = ctx if ctx is not None else (None, None)
         frames = []
         for tag in tags:
             if tag != domain and not tag.endswith(suffix):
                 continue
             node = self.cache.lookup(tag)
-            frames.append(protocol.node_frame(tag, node.data)
+            frames.append(protocol.node_frame(tag, node.data, tr, t0)
                           if node is not None
-                          else protocol.gone_frame(tag))
+                          else protocol.gone_frame(tag, tr, t0))
         if not frames:
             return
+        gen = self.cache.gen
         for link in list(self.links.values()):
             for frame in frames:
-                self._send(link, frame)
+                self._send_delta(link, frame)
+            # one digest frame per delta batch: the replica compares
+            # its roll against the owner's (replica-digest invariant)
+            if not link.closed and link.dg is not None:
+                self.digest_checks += 1
+                self._m_digest_checks.inc()
+                self._send(link, protocol.digest_frame(gen, link.dg))
+        self.tracer.observe("shard-frame", ctx)
+
+    def _send_delta(self, link: ShardLink, frame: dict) -> None:
+        """One mutation-log delta: roll the link's parity digest, then
+        send — unless a chaos ``skew-replica`` armed suppression, in
+        which case the digest rolls WITHOUT the send (the replica must
+        flag the divergence at the next digest frame)."""
+        if link.dg is not None:
+            link.dg = protocol.delta_digest(link.dg, frame)
+            if link.skew_pending > 0:
+                link.skew_pending -= 1
+                self.log.warning(
+                    "shard %d: suppressing one delta frame "
+                    "(chaos skew-replica)", link.shard)
+                return
+        self._send(link, frame)
+
+    def skew_replica(self, shard: int = -1,
+                     frames: int = 1) -> Optional[int]:
+        """Chaos ``skew-replica``: suppress the next *frames* delta
+        frames to one worker while still folding them into the owner's
+        digest roll — the replica-digest invariant must catch the
+        divergence within one mutation cycle.  ``shard=-1`` picks a
+        live digest-armed link at random; returns the skewed shard (or
+        None when no link is eligible)."""
+        candidates = [lk for lk in self.links.values()
+                      if not lk.closed and lk.dg is not None]
+        if not candidates:
+            return None
+        if shard < 0:
+            link = self._rng.choice(candidates)
+        else:
+            link = self.links.get(shard)
+            if link is None or link.closed or link.dg is None:
+                return None
+        link.skew_pending += max(1, int(frames))
+        return link.shard
 
     def _send(self, link: ShardLink, frame: dict) -> None:
         if link.closed:
@@ -478,6 +557,27 @@ class ShardSupervisor:
                     fut.set_result(frame)
             elif op == "stats":
                 self._fold_stats(link, frame)
+            elif op == "digest-report":
+                self._on_digest_report(link, frame)
+
+    def _on_digest_report(self, link: ShardLink, frame: dict) -> None:
+        """A replica flagged a mutation-log digest mismatch: count the
+        replica-digest violation and keep the evidence (the replica
+        already resynced its roll; operators decide whether to recycle
+        the shard — see docs/operations.md)."""
+        if frame.get("ok"):
+            return
+        self.digest_violations += 1
+        self._m_digest_violations.inc()
+        self.log.error(
+            "shard %d: replica digest mismatch at gen %s "
+            "(have %s want %s)", link.shard, frame.get("gen"),
+            frame.get("have"), frame.get("want"))
+        if self.recorder is not None:
+            self.recorder.record(
+                "verify-violation", invariant="replica-digest",
+                shard=link.shard, generation=frame.get("gen"),
+                have=frame.get("have"), want=frame.get("want"))
 
     def _fold_stats(self, link: ShardLink, frame: dict) -> None:
         link.stats = frame
@@ -714,6 +814,8 @@ class ShardSupervisor:
                 "udp_port": self.udp_port,
                 "tcp_port": self.tcp_port,
                 "respawns_total": sum(self.respawns.values()),
+                "digest_checks": self.digest_checks,
+                "digest_violations": self.digest_violations,
                 "workers": workers,
             },
             "flight_recorder": intro._recorder_section(),

@@ -397,6 +397,10 @@ class MirrorCache:
         # dependency tags (lookup domains / PTR qnames) whose answers a
         # mutation may have changed
         self._invalidate_cbs: List = []
+        # optional propagation tracer (binder_tpu/verify): bump_gen
+        # opens each mutation's trace context, invalidate marks the
+        # mirror-apply stage — both no-ops when unset
+        self.tracer = None
         # store-mirror observability (the reference gets the analogous
         # client metrics by passing its artedi collector into zkstream,
         # lib/zk.js:26-38); all optional — tests build bare caches
@@ -478,6 +482,8 @@ class MirrorCache:
     def invalidate(self, tags) -> None:
         if not tags:
             return
+        if self.tracer is not None:
+            self.tracer.on_mirror_applied()
         for cb in self._invalidate_cbs:
             try:
                 cb(tags)
@@ -486,6 +492,8 @@ class MirrorCache:
 
     def bump_gen(self) -> None:
         self.gen += 1
+        if self.tracer is not None:
+            self.tracer.on_store_event(self.gen)
         now = time.monotonic()
         self.last_mutation_mono = now
         if self.recorder is not None:

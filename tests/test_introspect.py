@@ -660,7 +660,7 @@ class TestSnapshotValidator:
                     "coalesced_frames": 0, "half_closes": 0,
                     "rst_drops": 0},
             "recursion": None, "precompile": None, "loop": None,
-            "flight_recorder": None, "policy": None,
+            "flight_recorder": None, "policy": None, "verify": None,
         }
         assert validate_status_snapshot(good) == []
         bad = json.loads(json.dumps(good))

@@ -519,8 +519,8 @@ def validate_status_snapshot(snap):
         _check_keys(sub, schema, section, errs)
     # nullable top-level sections must still be PRESENT (consumers key
     # on them to know the feature is off, not mistyped)
-    for section in ("recursion", "precompile", "loop", "flight_recorder",
-                    "policy"):
+    for section in ("recursion", "precompile", "verify", "loop",
+                    "flight_recorder", "policy"):
         if section not in snap:
             errs.append(f"{section}: key must be present (null when "
                         "the subsystem is off)")
@@ -581,6 +581,24 @@ def validate_status_snapshot(snap):
                     "declined", "shed", "seed_remaining"):
             if key not in pc:
                 errs.append(f"precompile: missing {key!r}")
+    vf = snap.get("verify")
+    if isinstance(vf, dict):
+        for key in ("enabled", "checks", "violations", "skipped",
+                    "queue_depth", "audit", "recent_violations",
+                    "propagation"):
+            if key not in vf:
+                errs.append(f"verify: missing {key!r}")
+        audit = vf.get("audit")
+        if isinstance(audit, dict):
+            for key in ("passes", "pending", "interval_seconds",
+                        "sample"):
+                if key not in audit:
+                    errs.append(f"verify.audit: missing {key!r}")
+        prop = vf.get("propagation")
+        if isinstance(prop, dict):
+            for key in ("observed", "stages", "slowest"):
+                if key not in prop:
+                    errs.append(f"verify.propagation: missing {key!r}")
     pol = snap.get("policy")
     if isinstance(pol, dict):
         for key in ("degradation", "admission", "rrl", "breakers_open"):
@@ -1036,6 +1054,95 @@ def validate_rrl_metrics(text):
                if parts and not parts[0].startswith("#")):
         errs.append('binder_shed_total: missing the '
                     'reason="response-ratelimit" series')
+    return errs
+
+
+# -- serving-plane verification metrics (ISSUE 16) --------------------
+#
+# The checker's whole value is that silence is never ambiguous: every
+# invariant's check/violation/skip series must exist from scrape 1
+# (zero-seeded at registration), and the propagation histogram must
+# carry every datapath stage before the first mutation.  An exporter
+# bug dropping a series would make "no violations" indistinguishable
+# from "not checking" — the exact failure the family exists to rule
+# out.  Wired into tier-1 via tests/test_verify.py and into
+# `make verify-smoke`.
+
+_VERIFY_FAMILIES = {
+    "binder_verify_checks_total": "counter",
+    "binder_verify_violations_total": "counter",
+    "binder_verify_skipped_total": "counter",
+    "binder_verify_queue_depth": "gauge",
+    "binder_propagation_seconds": "histogram",
+}
+#: the invariant catalog (binder_tpu/verify/checker.py INVARIANTS) —
+#: every value pinned on all three counters; the skip counter also
+#: carries the queue-shed series
+_VERIFY_INVARIANTS = ("dangling-srv", "ptr-coherence", "compiled-bytes",
+                      "replica-digest", "stale-epoch")
+#: the propagation stage catalog (binder_tpu/verify/tracer.py STAGES)
+_VERIFY_STAGES = ("mirror-apply", "shard-frame", "replica-apply",
+                  "precompile-render", "compiled-install",
+                  "native-install")
+
+
+def validate_verify_metrics(text):
+    """Validate that a Prometheus exposition carries the complete
+    ``binder_verify_*`` family plus the per-stage propagation
+    histogram: correct TYPE declarations, at least one sample each,
+    every invariant pinned on the three counters (queue-shed on the
+    skip counter), and every stage pinned on the histogram.  Returns
+    error strings; empty == valid."""
+    errs = list(validate_exposition(text))
+    types = {}
+    labels_seen = {}    # family -> {label name -> set(values)}
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("# TYPE") and len(parts) >= 4:
+            types[parts[2]] = parts[3]
+        elif line and not line.startswith("#") and parts:
+            brace = line.find("{")
+            name = line[:brace] if brace >= 0 else parts[0]
+            # histogram series expose under <fam>_bucket/_sum/_count
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) \
+                        and name[:-len(suffix)] in _VERIFY_FAMILIES:
+                    name = name[:-len(suffix)]
+                    break
+            fam_labels = labels_seen.setdefault(name, {})
+            if brace >= 0:
+                close = line.rfind("}")
+                for lname, lval in _parse_label_block(
+                        line[brace + 1:close], [], 0):
+                    fam_labels.setdefault(lname, set()).add(lval)
+            else:
+                fam_labels.setdefault(None, set()).add("")
+    for family, kind in _VERIFY_FAMILIES.items():
+        if family not in types:
+            errs.append(f"{family}: missing # TYPE declaration")
+        elif types[family] != kind:
+            errs.append(f"{family}: declared {types[family]!r}, "
+                        f"expected {kind!r}")
+        if family not in labels_seen:
+            errs.append(f"{family}: no samples in exposition")
+    for family in ("binder_verify_checks_total",
+                   "binder_verify_violations_total",
+                   "binder_verify_skipped_total"):
+        have = labels_seen.get(family, {}).get("invariant", set())
+        for inv in _VERIFY_INVARIANTS:
+            if inv not in have:
+                errs.append(f"{family}: missing pinned series "
+                            f"invariant={inv!r}")
+    if "queue-shed" not in labels_seen.get(
+            "binder_verify_skipped_total", {}).get("invariant", set()):
+        errs.append("binder_verify_skipped_total: missing pinned "
+                    "series invariant='queue-shed'")
+    have = labels_seen.get(
+        "binder_propagation_seconds", {}).get("stage", set())
+    for stage in _VERIFY_STAGES:
+        if stage not in have:
+            errs.append(f"binder_propagation_seconds: missing pinned "
+                        f"series stage={stage!r}")
     return errs
 
 
