@@ -5,7 +5,7 @@ PY ?= python3
 
 .PHONY: all native test check ci bench bench-smoke status-smoke \
 	chaos-smoke tcp-smoke shard-smoke zone-smoke federation-smoke \
-	hostile-smoke verify-smoke real-tiers clean
+	hostile-smoke verify-smoke balancer-smoke real-tiers clean
 
 all: native
 
@@ -59,6 +59,7 @@ ci:
 	BINDER_FEDERATION_SECONDS=10 $(MAKE) federation-smoke
 	BINDER_HOSTILE_SECONDS=10 $(MAKE) hostile-smoke
 	BINDER_VERIFY_SECONDS=10 $(MAKE) verify-smoke
+	BINDER_BALANCER_SECONDS=10 $(MAKE) balancer-smoke
 	@echo "ci: all gates passed"
 
 # one fast reduced-iteration bench pass proving the measured paths still
@@ -139,6 +140,16 @@ tcp-smoke:
 # BINDER_HOSTILE_SECONDS overrides the flood duration (ci trims to 10)
 hostile-smoke:
 	$(PY) tools/hostile_smoke.py
+
+# balancer-fronted end-to-end smoke: real mbalancer + two backends,
+# direct-return negotiation (fd passing), continuous fronted load with
+# a mid-stream backend kill + revival — zero client-visible timeouts,
+# affinity re-pointed, direct return renegotiated on re-adoption, and
+# the stats-socket stage/batch counters monotone across the churn
+# (docs/balancer-protocol.md); BINDER_BALANCER_SECONDS overrides the
+# duration (make ci trims to 10 s)
+balancer-smoke:
+	$(PY) tools/balancer_smoke.py
 
 # serving-plane verification smoke: clean soak (zero violations while
 # the checker, audit and propagation tracer all do real work, RSS

@@ -14,8 +14,11 @@ the same flags:
 plus the shard-mode long options (docs/operations.md "Sharded
 serving"):
 
-    --shards <n>        fork n serving workers behind one
+    --shards <n|auto>   fork n serving workers behind one
                         SO_REUSEPORT port, supervised by this process
+                        — the headline scale-out topology
+                        (docs/operations.md).  ``auto`` sizes the
+                        group to the machine (one worker per core).
                         (config key ``shards``; 0/absent = classic
                         single-process serving)
     --shard-worker <i>  INTERNAL: run as shard worker i, reading the
@@ -43,7 +46,7 @@ DEFAULTS: Dict[str, object] = {
 }
 
 USAGE = ("usage: binder [-v] [-a cacheExpiry] [-s cacheSize] [-p port] "
-         "[-b balancerSocket] [-f file] [--shards n]")
+         "[-b balancerSocket] [-f file] [--shards n|auto]")
 
 
 class ConfigError(Exception):
@@ -72,7 +75,9 @@ def parse_options(argv: Optional[List[str]] = None) -> Dict[str, object]:
         elif flag == "-s":
             cli["size"] = int(arg)
         elif flag == "--shards":
-            cli["shards"] = int(arg)
+            # "auto" = size the reuseport group to the machine; main.py
+            # resolves it so the config-file form works identically
+            cli["shards"] = arg if arg == "auto" else int(arg)
         elif flag == "--shard-worker":
             # internal: spawned by the shard supervisor, never by hand
             cli["shardWorker"] = int(arg)

@@ -22,6 +22,7 @@ import asyncio
 import errno
 import ipaddress
 import logging
+import os
 import socket
 import struct
 import time
@@ -42,6 +43,11 @@ _fp_serve_wire = getattr(_fastio, "fastpath_serve_wire", None)
 # bulk TCP-frame serve: every complete frame in a read chunk handled in
 # one C call (hits framed back as one writer call; misses surfaced)
 _fp_serve_frames = getattr(_fastio, "fastpath_serve_frames", None)
+# bulk balancer-frame serve with direct return: every UDP-transport hit
+# in a read chunk is answered straight onto the balancer's passed
+# client-facing socket via one sendmmsg; misses/control/TCP frames
+# surface for the Python lane
+_fp_serve_balancer = getattr(_fastio, "fastpath_serve_balancer", None)
 
 # Sentinel an on_query hook may return instead of an awaitable: the
 # query is in flight and the HANDLER owns its completion — response AND
@@ -57,6 +63,16 @@ TRANSPORT_TCP = 1
 # response-only marker: route like UDP but no cache layer may keep it
 # (recursion answers belong to another DC's store)
 TRANSPORT_UDP_NO_STORE = 2
+
+# Control-frame opcodes (family 0; the transport byte is the opcode).
+CTL_GEN = 0          # backend→balancer: generation report
+CTL_INVALIDATE = 1   # backend→balancer: dependency-tag invalidate
+# Direct-return negotiation, both directions.  Backend→balancer: this
+# backend accepts a passed client socket (so the balancer never sends
+# the frame first — an old backend would fail the family check below
+# and drop the link).  Balancer→backend: rides the sendmsg whose
+# SCM_RIGHTS ancillary data carries the client-facing UDP socket.
+CTL_DIRECT = 2
 
 
 def pack_balancer_frame(family: int, addr: str, port: int,
@@ -90,6 +106,15 @@ def pack_invalidate_frame(tag_wire: bytes) -> bytes:
                        0) + tag_wire
 
 
+def pack_direct_frame() -> bytes:
+    """Control frame (opcode 2) announcing direct-return capability to
+    the balancer.  An old balancer ignores the unknown opcode; a new one
+    answers by passing its client-facing UDP socket over SCM_RIGHTS on a
+    frame with the same opcode (docs/balancer-protocol.md)."""
+    return struct.pack(">IBBB16sH", BALANCER_HDR, BALANCER_VERSION, 0,
+                       CTL_DIRECT, b"\x00" * 16, 0)
+
+
 def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
     version, family, transport, raw, port = struct.unpack_from(
         ">BBB16sH", frame, 0)
@@ -105,6 +130,372 @@ def unpack_balancer_frame(frame: bytes) -> Tuple[int, str, int, int, bytes]:
     else:
         raise WireError(f"bad address family {family}")
     return family, addr, port, transport, frame[BALANCER_HDR:]
+
+
+class BalancerLink:
+    """One balancer connection, backend side, on a raw socket (asyncio
+    streams would discard the SCM_RIGHTS ancillary data that carries
+    the passed client socket).
+
+    Lifecycle: on accept the backend reports its generation, then
+    announces direct-return capability (opcode 2).  A capable balancer
+    answers with an fd-pass frame whose ancillary data is its
+    client-facing UDP socket; from then on every UDP-transport response
+    leaves straight for the client from this process — one sendmmsg per
+    read chunk on the native fast path — and only TCP-framed responses
+    ride the relay.  An old balancer skips the unknown opcode and the
+    link stays a pure relay, byte-compatible with the classic protocol.
+
+    Relay writes are append-ordered into one buffer, which preserves
+    the causal order the old per-connection lock defended: a response
+    computed under pre-mutation data is appended synchronously when its
+    send callback runs, before the call_soon that broadcasts the
+    generation frame invalidating it can fire.
+    """
+
+    #: recv_fds chunk size — large enough that a deep balancer pipeline
+    #: drains in few syscalls
+    _READ_CHUNK = 256 * 1024
+    #: queued-relay cap: a balancer that stops reading is dead weight,
+    #: not backpressure — drop the link and let it reconnect
+    _MAX_WRITE_BUFFER = 8 * 1024 * 1024
+
+    def __init__(self, engine: "DnsServer", sock: socket.socket,
+                 loop) -> None:
+        self.engine = engine
+        self.sock = sock
+        self.loop = loop
+        self.fd = sock.fileno()
+        self.log = engine.log
+        self._rbuf = bytearray()
+        self._wbuf = bytearray()
+        self._writing = False      # add_writer armed
+        self._flush_soon = False   # coalesced relay flush scheduled
+        self._fds: list = []       # passed fds awaiting their frame
+        self.direct_sock: Optional[socket.socket] = None
+        # non-None while a read pass is draining: synchronous direct
+        # responses batch into it and flush as one sendmmsg
+        self._direct_box: List[Optional[list]] = [None]
+        self._direct_late: list = []
+        self._closed = False
+
+    def start(self) -> None:
+        engine = self.engine
+        engine._conns.add(self)
+        if engine.gen_source is not None:
+            # report our generation immediately so the balancer can
+            # cache from the first response; per-link and unconditional
+            # (a fresh balancer knows nothing), also seeds the dedupe
+            # tracker
+            val = engine.gen_source()
+            self.send_frame(pack_gen_frame(val))
+            engine._last_gen_sent = val
+            engine._balancer_writers[self] = True
+        if engine.balancer_direct_return:
+            self.send_frame(pack_direct_frame())
+        self.loop.add_reader(self.fd, self._on_readable)
+
+    # -- reads --
+
+    def _on_readable(self) -> None:
+        try:
+            data, fds, _flags, _addr = socket.recv_fds(
+                self.sock, self._READ_CHUNK, 8)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as e:
+            self.log.error("balancer link read failed: %s", e)
+            self.close()
+            return
+        for fd in fds:
+            os.set_inheritable(fd, False)
+        self._fds.extend(fds)
+        if not data and not fds:
+            self.close()   # EOF
+            return
+        self._rbuf += data
+        self._process()
+
+    def _process(self) -> None:
+        engine = self.engine
+        buf = self._rbuf
+        out: list = []
+        self._direct_box[0] = out
+        try:
+            fp = engine.fastpath
+            if (fp is not None and _fp_serve_balancer is not None
+                    and self.direct_sock is not None
+                    and (engine.fastpath_gate is None
+                         or engine.fastpath_gate())):
+                gen = engine.fastpath_gen() if engine.fastpath_gen else 0
+                try:
+                    consumed, _served, misses = _fp_serve_balancer(
+                        fp, buf, gen, self.direct_sock.fileno())
+                except OSError as e:
+                    # the passed socket went bad under us: drop direct
+                    # mode, the relay lane still works, and the whole
+                    # chunk re-parses below (a duplicate UDP reply for
+                    # an already-sent hit is harmless — clients dedupe
+                    # by query id)
+                    self.log.error("direct-return send failed, "
+                                   "reverting to relay: %s", e)
+                    self._drop_direct()
+                else:
+                    del buf[:consumed]
+                    for frame in misses:
+                        if not self._handle_frame(bytes(frame),
+                                                  from_native=True):
+                            self.close()
+                            return
+                    log_flush = engine.fastpath_log_flush
+                    if log_flush is not None:
+                        try:
+                            log_flush()
+                        except Exception:
+                            self.log.exception(
+                                "query-log ring drain failed")
+            # Python lane: whatever the native pass left behind —
+            # everything, when there is no cache / no passed fd / the
+            # gate is closed; only a trailing partial or garbage frame
+            # otherwise
+            while not self._closed:
+                if len(buf) < 4:
+                    break
+                length = int.from_bytes(buf[:4], "big")
+                if length < BALANCER_HDR or length > MAX_FRAME:
+                    self.log.error("balancer frame length %d out of "
+                                   "range", length)
+                    self.close()
+                    return
+                if len(buf) < 4 + length:
+                    break
+                frame = bytes(buf[4:4 + length])
+                del buf[:4 + length]
+                if not self._handle_frame(frame):
+                    self.close()
+                    return
+        finally:
+            self._direct_box[0] = None
+            if out and not self._closed:
+                self._send_direct_batch(out)
+            self._flush()
+
+    def _handle_frame(self, frame: bytes,
+                      from_native: bool = False) -> bool:
+        """One complete frame (no length prefix).  Returns False on a
+        protocol error that must drop the link."""
+        engine = self.engine
+        if frame[0] != BALANCER_VERSION:
+            engine.log.error("balancer protocol error: unknown balancer "
+                             "protocol version %d", frame[0])
+            return False
+        if frame[1] == 0:
+            # control frame from the balancer; unknown opcodes are
+            # skipped so the protocol can grow without lockstep
+            # upgrades (mirrors the balancer's own consume loop)
+            if frame[2] == CTL_DIRECT:
+                self._adopt_direct_fd()
+            else:
+                engine.log.debug("ignoring balancer control opcode %d",
+                                 frame[2])
+            return True
+        try:
+            family, addr, port, transport, payload = \
+                unpack_balancer_frame(frame)
+        except WireError as e:
+            engine.log.error("balancer protocol error: %s", e)
+            return False
+        if transport == TRANSPORT_UDP_NO_STORE:
+            # response-only marker; never valid on a request
+            engine.log.error("balancer protocol error: "
+                             "do-not-store transport on a request")
+            return False
+
+        ctx_box: list = []
+
+        def send(wire: bytes, f=family, a=addr, p=port, t=transport,
+                 box=ctx_box) -> None:
+            if t == TRANSPORT_UDP and self.direct_sock is not None:
+                # direct return: the response leaves on the balancer's
+                # own client-facing socket and never re-enters the
+                # balancer — which also makes the do-not-store marker
+                # moot (nothing sees the response to cache it)
+                self._send_direct(wire, (a, p))
+                return
+            t_out = t
+            if t == TRANSPORT_UDP and box and box[0].no_store:
+                # recursion-produced responses carry the do-not-store
+                # marker so the balancer won't cache another DC's data
+                # under our generation
+                t_out = TRANSPORT_UDP_NO_STORE
+            self.send_frame(pack_balancer_frame(f, a, p, wire,
+                                                transport=t_out))
+
+        try:
+            engine._handle_raw(
+                payload, (addr, port), "balancer", send,
+                client_transport=("tcp" if transport == TRANSPORT_TCP
+                                  else "udp"),
+                ctx_box=ctx_box,
+                # the native pass already probed the cache for the
+                # UDP-transport frames it surfaces; TCP frames bypass
+                # it there and still get their serve_wire probe
+                fastpath_checked=(from_native
+                                  and transport == TRANSPORT_UDP))
+        except Exception:
+            # isolate per frame: a bug on one query must not drop the
+            # link and every other client multiplexed on it
+            engine.log.exception("unhandled error processing balancer "
+                                 "frame for %s", addr)
+        return True
+
+    # -- direct return --
+
+    def _adopt_direct_fd(self) -> None:
+        if not self._fds:
+            # ancillary data stripped (or a confused balancer): stay on
+            # the relay lane, which is always correct
+            self.log.warning("balancer fd-pass frame carried no "
+                             "descriptor; staying on relay lane")
+            return
+        fd = self._fds.pop(0)
+        self._drop_direct()
+        # the passed descriptor shares the balancer's file description:
+        # O_NONBLOCK is already set over there and toggling it here
+        # would flip it under the balancer too
+        self.direct_sock = socket.socket(fileno=fd)
+        self.log.info("balancer passed its client socket: UDP "
+                      "responses now return directly")
+
+    def _drop_direct(self) -> None:
+        if self.direct_sock is not None:
+            try:
+                self.direct_sock.close()
+            except OSError:
+                pass
+            self.direct_sock = None
+
+    def _send_direct(self, wire: bytes, addr) -> None:
+        box = self._direct_box[0]
+        if box is not None:
+            box.append((wire, addr))
+            return
+        # late (async-completed) response: coalesce per event-loop pass
+        if not self._direct_late:
+            self.loop.call_soon(self._flush_direct_late)
+        self._direct_late.append((wire, addr))
+
+    def _flush_direct_late(self) -> None:
+        out = self._direct_late[:]
+        self._direct_late.clear()
+        if out and not self._closed:
+            self._send_direct_batch(out)
+
+    def _send_direct_batch(self, out: list) -> None:
+        sock = self.direct_sock
+        if sock is None:
+            # direct mode dropped between queueing and flush: the
+            # responses are still deliverable over the relay
+            for wire, (a, p) in out:
+                fam = 6 if ":" in a else 4
+                self.send_frame(pack_balancer_frame(fam, a, p, wire))
+            return
+        if _fastio is not None:
+            try:
+                sent = _fastio.send_batch(sock.fileno(), out)
+                if sent < len(out):
+                    # socket buffer full: one retry, then drop (UDP
+                    # clients retransmit; blocking would stall every
+                    # other client on the loop)
+                    sent += _fastio.send_batch(sock.fileno(), out[sent:])
+                    if sent < len(out):
+                        self.log.debug("dropped %d direct responses "
+                                       "(send buffer full)",
+                                       len(out) - sent)
+            except OSError as e:
+                self.log.error("direct-return send failed, reverting "
+                               "to relay: %s", e)
+                self._drop_direct()
+            return
+        # pure-Python fallback (extension not built)
+        for wire, addr in out:
+            try:
+                sock.sendto(wire, addr)
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError as e:
+                self.log.error("direct-return send failed, reverting "
+                               "to relay: %s", e)
+                self._drop_direct()
+                return
+
+    # -- relay / control-frame writes --
+
+    def send_frame(self, data: bytes) -> None:
+        if self._closed:
+            return
+        self._wbuf += data
+        if len(self._wbuf) > self._MAX_WRITE_BUFFER:
+            self.log.error("balancer link write buffer overflow "
+                           "(%d bytes): dropping link", len(self._wbuf))
+            self.close()
+            return
+        if not self._writing and not self._flush_soon:
+            # coalesce same-turn frames into one send
+            self._flush_soon = True
+            self.loop.call_soon(self._flush_scheduled)
+
+    def _flush_scheduled(self) -> None:
+        self._flush_soon = False
+        self._flush()
+
+    def _flush(self) -> None:
+        if self._closed or not self._wbuf:
+            return
+        try:
+            n = self.sock.send(self._wbuf)
+        except (BlockingIOError, InterruptedError):
+            n = 0
+        except OSError:
+            self.close()   # balancer went away; responses are lost
+            return
+        if n:
+            del self._wbuf[:n]
+        if self._wbuf and not self._writing:
+            self._writing = True
+            self.loop.add_writer(self.fd, self._flush)
+        elif not self._wbuf and self._writing:
+            self._writing = False
+            self.loop.remove_writer(self.fd)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        engine = self.engine
+        engine._balancer_writers.pop(self, None)
+        engine._conns.discard(self)
+        try:
+            self.loop.remove_reader(self.fd)
+        except (OSError, ValueError):
+            pass
+        if self._writing:
+            self._writing = False
+            try:
+                self.loop.remove_writer(self.fd)
+            except (OSError, ValueError):
+                pass
+        for fd in self._fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds.clear()
+        self._drop_direct()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 class DnsServer:
@@ -158,7 +549,7 @@ class DnsServer:
         self._udp_socks: List[tuple] = []   # (loop, socket)
         self._tcp_listeners: List[tuple] = []   # (loop, socket)
         self._tcp_sweep_handle = None       # idle-sweep TimerHandle
-        self._unix_servers: List[asyncio.AbstractServer] = []
+        self._unix_servers: List[tuple] = []   # (loop, socket, path)
         self._tasks: set = set()
         # live stream connections (TCP clients, balancer links) — must be
         # force-closed on shutdown or Server.wait_closed() blocks on
@@ -217,10 +608,18 @@ class DnsServer:
         # Optional flight recorder (installed by BinderServer): the
         # engine's error path records resolver-error events on it.
         self.recorder = None
-        self._balancer_writers: dict = {}   # writer -> per-conn write lock
+        # live BalancerLink objects receiving gen/invalidate broadcasts
+        # (dict for cheap membership + stable iteration order)
+        self._balancer_writers: dict = {}
         self._gen_dirty = False
         self._pending_inval: set = set()    # tag wires awaiting broadcast
         self._last_gen_sent: Optional[int] = None
+        # Direct-return negotiation switch: announce the capability on
+        # every balancer link so a capable balancer passes its client
+        # socket.  BINDER_NO_DIRECT_RETURN=1 keeps the classic pure
+        # relay — the A/B lever for tests and the bench's relay arm.
+        self.balancer_direct_return = os.environ.get(
+            "BINDER_NO_DIRECT_RETURN", "") not in ("1", "true", "yes")
 
     # -- shared query dispatch --
     #
@@ -851,9 +1250,34 @@ class DnsServer:
     # -- balancer backend socket (docs/balancer-protocol.md) --
 
     async def listen_balancer(self, path: str) -> None:
-        server = await asyncio.start_unix_server(self._balancer_conn, path)
-        self._unix_servers.append(server)
+        # raw listener + raw per-link sockets, not asyncio streams: the
+        # direct-return fd pass arrives as SCM_RIGHTS ancillary data,
+        # which the stream protocol machinery silently discards
+        loop = asyncio.get_running_loop()
+        lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            lsock.setblocking(False)
+            lsock.bind(path)
+            lsock.listen(64)
+        except OSError:
+            lsock.close()
+            raise
+        loop.add_reader(lsock.fileno(), self._on_balancer_accept, lsock,
+                        loop)
+        self._unix_servers.append((loop, lsock, path))
         self.log.info("balancer service started on %s", path)
+
+    def _on_balancer_accept(self, lsock: socket.socket, loop) -> None:
+        for _ in range(self._ACCEPT_BURST):
+            try:
+                sock, _peer = lsock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                self.log.error("balancer accept failed: %s", e)
+                return
+            sock.setblocking(False)
+            BalancerLink(self, sock, loop).start()
 
     def notify_mutation(self) -> None:
         """Broadcast a fresh generation frame to every balancer link,
@@ -905,101 +1329,14 @@ class DnsServer:
             frame += pack_invalidate_frame(tag)
         if not frame:
             return
-        for writer, lock in list(self._balancer_writers.items()):
-            # the frame must go through the same ordered write path as
-            # responses: a bare write could overtake a response computed
-            # under the OLD generation that is still parked behind the
-            # lock, and the balancer would tag that stale response with
-            # the new generation.  Task-creation order is the causal
-            # order (the stale response's task exists before the
-            # mutation ran), and asyncio's FIFO scheduling + FIFO lock
-            # waiters preserve it.
-            async def _write(w=writer, lk=lock):
-                try:
-                    async with lk:
-                        w.write(frame)
-                        await w.drain()
-                except (ConnectionResetError, BrokenPipeError, OSError):
-                    pass   # link died; the reader side cleans up
-            task = asyncio.ensure_future(_write())
-            self._tasks.add(task)
-            task.add_done_callback(self._tasks.discard)
-
-    async def _balancer_conn(self, reader: asyncio.StreamReader,
-                             writer: asyncio.StreamWriter) -> None:
-        lock = asyncio.Lock()
-        self._conns.add(writer)
-        if self.gen_source is not None:
-            # report our generation immediately so the balancer can cache
-            # from the first response
-            # connect-time report is per-link and unconditional (a fresh
-            # balancer knows nothing); it also seeds the dedupe tracker
-            val = self.gen_source()
-            writer.write(pack_gen_frame(val))
-            self._last_gen_sent = val
-            self._balancer_writers[writer] = lock
-        try:
-            while True:
-                hdr = await reader.readexactly(4)
-                (length,) = struct.unpack(">I", hdr)
-                if length < BALANCER_HDR or length > MAX_FRAME:
-                    self.log.error("balancer frame length %d out of range",
-                                   length)
-                    return
-                frame = await reader.readexactly(length)
-                try:
-                    family, addr, port, transport, payload = \
-                        unpack_balancer_frame(frame)
-                except WireError as e:
-                    self.log.error("balancer protocol error: %s", e)
-                    return
-                if transport == TRANSPORT_UDP_NO_STORE:
-                    # response-only marker; never valid on a request
-                    self.log.error("balancer protocol error: "
-                                   "do-not-store transport on a request")
-                    return
-
-                ctx_box: list = []
-
-                def send(wire: bytes, f=family, a=addr, p=port,
-                         t=transport, box=ctx_box) -> None:
-                    # recursion-produced responses carry the
-                    # do-not-store marker so the balancer won't cache
-                    # another DC's data under our generation
-                    t_out = t
-                    if (t == TRANSPORT_UDP and box
-                            and box[0].no_store):
-                        t_out = TRANSPORT_UDP_NO_STORE
-                    out = pack_balancer_frame(f, a, p, wire,
-                                              transport=t_out)
-                    # serialize frame writes from concurrent queries
-                    async def _write():
-                        try:
-                            async with lock:
-                                writer.write(out)
-                                await writer.drain()
-                        except (ConnectionResetError, BrokenPipeError,
-                                OSError):
-                            pass  # balancer went away; response is lost
-                    task = asyncio.ensure_future(_write())
-                    self._tasks.add(task)
-                    task.add_done_callback(self._tasks.discard)
-
-                self._handle_raw(
-                    payload, (addr, port), "balancer", send,
-                    client_transport=("tcp" if transport == TRANSPORT_TCP
-                                      else "udp"),
-                    ctx_box=ctx_box)
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
-        finally:
-            self._balancer_writers.pop(writer, None)
-            self._conns.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
+        for link in list(self._balancer_writers):
+            # the frame rides the same append-ordered write buffer as
+            # relay responses: a response computed under the OLD
+            # generation was appended synchronously when its send
+            # callback ran — before the call_soon that brought us here
+            # could fire — so the balancer never tags a stale response
+            # with the new generation
+            link.send_frame(frame)
 
     # -- lifecycle --
 
@@ -1021,9 +1358,15 @@ class DnsServer:
             lsock.close()
         for w in list(self._conns):
             w.close()
-        for s in self._unix_servers:
-            s.close()
-            await s.wait_closed()
+        for loop, lsock, path in self._unix_servers:
+            try:
+                loop.remove_reader(lsock.fileno())
+            except (OSError, ValueError):
+                pass
+            # note: the path is NOT unlinked here — supervisor SIGTERM
+            # semantics own the unlink (main.py), matching the old
+            # stream-server behavior callers test against
+            lsock.close()
         for task in list(self._tasks):
             task.cancel()
         self._udp_socks.clear()

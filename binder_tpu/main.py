@@ -183,9 +183,22 @@ async def run_supervisor(options: Dict[str, object]):
     return supervisor
 
 
+def resolve_shard_count(options: Dict[str, object]) -> int:
+    """``shards: "auto"`` sizes the reuseport group to the machine —
+    one single-threaded worker per core is the sizing rule
+    (docs/operations.md "Sizing N")."""
+    n = options.get("shards") or 0
+    if n == "auto":
+        n = os.cpu_count() or 1
+    return int(n)
+
+
 async def run(options: Dict[str, object]) -> BinderServer:
     shard_worker = options.get("shardWorker")
-    if shard_worker is None and int(options.get("shards") or 0) >= 1:
+    # resolve "auto" up front so the supervisor and its status
+    # plumbing only ever see an int
+    options["shards"] = resolve_shard_count(options)
+    if shard_worker is None and options["shards"] >= 1:
         return await run_supervisor(options)
 
     log = make_logger(NAME, str(options.get("logLevel", os.environ.get(

@@ -63,6 +63,14 @@ constexpr uint8_t kTransportTcp = 1;
 /* response-only marker from backends: route like UDP, never cache
  * (recursion answers belong to another DC's store) */
 constexpr uint8_t kTransportUdpNoStore = 2;
+/* control-frame opcodes (family 0; opcode rides the transport byte).
+ * 0/1 are backend->balancer; 2 is the direct-return negotiation: the
+ * backend announces the capability, and the balancer answers with the
+ * same opcode carrying its client-facing UDP fd via SCM_RIGHTS
+ * (docs/balancer-protocol.md "Direct-return negotiation"). */
+constexpr uint8_t kCtlGen = 0;
+constexpr uint8_t kCtlInvalidate = 1;
+constexpr uint8_t kCtlDirect = 2;
 constexpr size_t kMaxUdpPacket = 65535;
 /* Affinity-table cap: the map is keyed by remote host, and mbalancer owns
  * a public UDP port — without a bound, spoofed source addresses would grow
@@ -71,6 +79,14 @@ constexpr size_t kMaxUdpPacket = 65535;
 constexpr size_t kMaxRemotes = 65536;
 
 int g_verbose = 0;
+/* -D: keep every reply on the relay lane even for capable backends
+ * (the bench A/B arm, and an operator escape hatch) */
+int g_no_direct = 0;
+/* packet-path syscall count (epoll_wait, recvmmsg, sendmmsg, read,
+ * writev, accept4, the fd-pass sendmsg): with direct return the bench
+ * divides this by queries to prove the per-query kernel-crossing floor
+ * actually dropped, not just the cycle shares */
+uint64_t g_syscalls = 0;
 
 void logmsg(const char *fmt, ...) {
     va_list ap;
@@ -249,6 +265,7 @@ struct Stream {
                 iov[cnt].iov_len = it->size() - skip;
             }
             ssize_t n = writev(fd, iov, cnt);
+            g_syscalls++;
             if (n < 0) {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
                 if (errno == EINTR) continue;
@@ -368,6 +385,13 @@ struct Backend {
     uint64_t gen = 0;
     bool gen_known = false;
     uint32_t epoch = 0;
+    /* direct-return negotiation state (docs/balancer-protocol.md):
+     * capability announced by the backend (control opcode 2), fd
+     * passed by us via SCM_RIGHTS; pending marks a deferred pass
+     * (write queue busy at announce time) retried by the timer sweep */
+    bool direct_capable = false;
+    bool fd_passed = false;
+    bool fd_pass_pending = false;
     /* per-backend answer cache (see backend_cache_clear for the
      * invalidation invariant) */
     std::unordered_map<std::string, CacheEntry> cache;
@@ -440,6 +464,16 @@ struct Balancer {
     uint64_t idle_closes = 0;     /* TCP clients evicted for idleness */
     uint64_t client_evictions = 0; /* evicted to admit a new client */
     uint64_t backend_stalls = 0;  /* backends downed for a stuck queue */
+    /* direct-return accounting: fds passed (one per negotiated backend
+     * connection) and queries forwarded with the reply hop eliminated */
+    uint64_t fd_passes = 0;
+    uint64_t direct_forwards = 0;
+    /* recvmmsg batch-size histogram on the UDP front (log2 cells:
+     * 1, 2-3, 4-7, ..., >=128): proves the batching survived whatever
+     * the datapath change was — a collapse to cell 0 is per-packet
+     * dispatch again */
+    static constexpr int kBatchCells = 8;
+    uint64_t udp_batch_cells[kBatchCells] = {0};
     uint64_t started_at = 0;
 };
 
@@ -483,6 +517,7 @@ uint64_t tag(Kind kind, int fd) { return ((uint64_t)kind << 32) | (uint32_t)fd; 
 /* ---------------- backend management ---------------- */
 
 void backend_cache_clear(Backend &be);   /* defined with the cache below */
+void maybe_pass_fd(Backend &be);         /* defined with the framing below */
 
 void backend_mark_down(Backend &be) {
     if (be.conn.fd >= 0) {
@@ -494,6 +529,11 @@ void backend_mark_down(Backend &be) {
     be.gen_known = false;
     be.stall_ticks = 0;
     be.last_flushed_total = 0;
+    /* negotiation is per connection: a reconnected backend re-announces
+     * capability and receives a fresh fd */
+    be.direct_capable = false;
+    be.fd_passed = false;
+    be.fd_pass_pending = false;
     backend_cache_clear(be);   /* a restarted process restarts its gen */
 }
 
@@ -513,6 +553,9 @@ bool backend_connect(Backend &be) {
     be.conn.fd = fd;
     be.stall_ticks = 0;
     be.last_flushed_total = 0;
+    be.direct_capable = false;
+    be.fd_passed = false;
+    be.fd_pass_pending = false;
     be.healthy = true;   /* optimistic; demoted on first error */
     /* new process behind the same socket path: its generation counter
      * restarts, so retire every cache entry from the previous epoch */
@@ -606,6 +649,8 @@ void sweep_connections() {
             be.stall_ticks = 0;
         }
         be.last_flushed_total = be.conn.flushed_total;
+        if (be.fd_pass_pending)
+            maybe_pass_fd(be);   /* deferred pass: queue was busy */
     }
 }
 
@@ -652,6 +697,70 @@ std::vector<uint8_t> make_frame(const ClientKey &k, uint8_t transport,
     out[24] = (uint8_t)(k.port & 0xff);
     memcpy(out.data() + 25, payload, len);
     return out;
+}
+
+/* ---------------- direct-return fd passing ----------------
+ *
+ * A capable backend (control opcode 2) receives our client-facing UDP
+ * socket over the UNIX channel via SCM_RIGHTS and answers UDP clients
+ * on it directly (sendmmsg with the frame's sockaddr as msg_name) —
+ * the reply never re-enters this process.  The ancillary payload must
+ * ride a specific sendmsg, so the pass happens only while the backend
+ * stream's write queue is empty (otherwise mid-frame bytes would be
+ * interleaved); a busy queue defers the pass to the timer sweep. */
+void maybe_pass_fd(Backend &be) {
+    if (g_no_direct || !be.direct_capable || be.fd_passed ||
+        be.conn.fd < 0 || g_bal.udp_fd < 0)
+        return;
+    if (be.conn.want_write()) {
+        be.fd_pass_pending = true;
+        return;
+    }
+    uint8_t frame[4 + kFrameHdr];
+    uint32_t L = htonl((uint32_t)kFrameHdr);
+    memcpy(frame, &L, 4);
+    frame[4] = kProtoVersion;
+    frame[5] = 0;            /* control */
+    frame[6] = kCtlDirect;   /* fd-pass */
+    memset(frame + 7, 0, kFrameHdr - 3);
+    struct iovec iov;
+    iov.iov_base = frame;
+    iov.iov_len = sizeof(frame);
+    char cbuf[CMSG_SPACE(sizeof(int))];
+    memset(cbuf, 0, sizeof(cbuf));
+    struct msghdr msg{};
+    msg.msg_iov = &iov;
+    msg.msg_iovlen = 1;
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof(cbuf);
+    struct cmsghdr *cm = CMSG_FIRSTHDR(&msg);
+    cm->cmsg_level = SOL_SOCKET;
+    cm->cmsg_type = SCM_RIGHTS;
+    cm->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(cm), &g_bal.udp_fd, sizeof(int));
+    ssize_t n;
+    do {
+        n = sendmsg(be.conn.fd, &msg, MSG_NOSIGNAL);
+    } while (n < 0 && errno == EINTR);
+    g_syscalls++;
+    if (n == (ssize_t)sizeof(frame)) {
+        be.fd_passed = true;
+        be.fd_pass_pending = false;
+        g_bal.fd_passes++;
+        tracemsg("backend %d: direct-return fd passed", be.id);
+        return;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        be.fd_pass_pending = true;   /* socket buffer full: retry later */
+        return;
+    }
+    /* Hard failure (or a partial write, impossible for 25 bytes into an
+     * empty non-blocking stream buffer but handled): direct return is
+     * an optimization, so give up on the pass and keep the relay lane;
+     * a genuinely broken link fails on the next regular write/read. */
+    be.fd_pass_pending = false;
+    logmsg("backend %d: fd pass failed (%s), staying on relay lane",
+           be.id, n < 0 ? strerror(errno) : "partial write");
 }
 
 /* ---------------- answer cache ----------------
@@ -845,6 +954,7 @@ void udp_out_flush() {
     while (off < g_udp_out.n) {
         int sent = sendmmsg(g_bal.udp_fd, g_udp_out.msgs + off,
                             (unsigned)(g_udp_out.n - off), MSG_DONTWAIT);
+        g_syscalls++;
         if (sent >= 0) {
             off += sent > 0 ? sent : 1;
             continue;
@@ -919,11 +1029,19 @@ void handle_udp() {
             msgs[i].msg_hdr.msg_namelen = sizeof(addrs[i]);
         }
         int n = recvmmsg(g_bal.udp_fd, msgs, 64, MSG_DONTWAIT, nullptr);
+        g_syscalls++;
         if (n < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
                 break;
             logmsg("udp recv error: %s", strerror(errno));
             break;
+        }
+        if (n > 0) {
+            int cell = 0;
+            while (cell < Balancer::kBatchCells - 1 &&
+                   (1 << (cell + 1)) <= n)
+                cell++;
+            g_bal.udp_batch_cells[cell]++;
         }
         for (int i = 0; i < n; i++) {
             size_t plen = msgs[i].msg_len;
@@ -932,6 +1050,22 @@ void handle_udp() {
             g_bal.udp_queries++;
             ClientKey ck = key_from_sockaddr(addrs[i]);
 
+            /* direct-return backends answer UDP clients on our socket
+             * themselves: no reply ever transits this process, so the
+             * answer cache can never fill for them — skip the probe
+             * and pending bookkeeping, just forward */
+            {
+                int didx = pick_backend(ck);
+                if (didx < 0) {
+                    g_bal.drops++;
+                    continue;
+                }
+                if (g_bal.backends[didx].fd_passed) {
+                    g_bal.direct_forwards++;
+                    forward_query_to(didx, ck, kTransportUdp, pkt, plen);
+                    continue;
+                }
+            }
             if (g_bal.cache_ms > 0) {
                 /* attribution: key build + affinity pick + cache
                  * lookup + hit serve / miss record (the nested
@@ -1020,6 +1154,7 @@ void handle_tcp_accept() {
         socklen_t slen = sizeof(ss);
         int fd = accept4(g_bal.tcp_fd, (struct sockaddr *)&ss, &slen,
                          SOCK_NONBLOCK);
+        g_syscalls++;
         if (fd < 0) return;
         if ((int)g_bal.tcp_clients.size() >= g_bal.max_tcp_clients) {
             /* At the connection cap: evict the idlest client to admit
@@ -1079,6 +1214,7 @@ void handle_tcp_client(int fd, uint32_t events) {
     uint8_t buf[16384];
     for (;;) {
         ssize_t n = read(fd, buf, sizeof(buf));
+        g_syscalls++;
         if (n < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) break;
             flush_pending_backends();
@@ -1285,7 +1421,7 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
              * 1 = per-name invalidate: the payload after the frame
              * header is the tag qname wire; drop exactly the entries
              * whose answers derive from it (ordinary store churn). */
-            if (f[2] == 0 && L >= kFrameHdr) {
+            if (f[2] == kCtlGen && L >= kFrameHdr) {
                 uint64_t g = 0;
                 for (int b = 0; b < 8; b++)
                     g = (g << 8) | f[3 + b];
@@ -1293,7 +1429,12 @@ bool backend_consume(Backend &be, const uint8_t *buf, size_t n) {
                     backend_cache_clear(be);   /* all entries stale */
                 be.gen = g;
                 be.gen_known = true;
-            } else if (f[2] == 1 && L > kFrameHdr) {
+            } else if (f[2] == kCtlDirect) {
+                /* direct-return capability announce: answer with our
+                 * UDP fd over SCM_RIGHTS (unless -D keeps the relay) */
+                be.direct_capable = true;
+                maybe_pass_fd(be);
+            } else if (f[2] == kCtlInvalidate && L > kFrameHdr) {
                 size_t tlen = L - kFrameHdr;
                 if (tlen >= 2 && tlen <= 256)
                     /* batched: applied in one cache scan after the
@@ -1350,14 +1491,18 @@ void handle_backend(int fd, uint32_t events) {
             backend_mark_down(be);
             return;
         }
-        if (!be.conn.want_write())
+        if (!be.conn.want_write()) {
             epoll_mod(fd, EPOLLIN, tag(KIND_BACKEND, fd));
+            if (be.fd_pass_pending)
+                maybe_pass_fd(be);   /* queue just drained */
+        }
     }
     if (!(events & EPOLLIN)) return;
 
     uint8_t buf[16384];
     for (;;) {
         ssize_t n = read(fd, buf, sizeof(buf));
+        g_syscalls++;
         if (n < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) break;
             logmsg("backend %d read error: %s", be.id, strerror(errno));
@@ -1400,6 +1545,10 @@ void handle_stats() {
                  "  \"idle_closes\": %llu,\n"
                  "  \"client_evictions\": %llu,\n"
                  "  \"backend_stalls\": %llu,\n"
+                 "  \"direct_return\": %s,\n"
+                 "  \"fd_passes\": %llu,\n"
+                 "  \"direct_forwards\": %llu,\n"
+                 "  \"syscalls\": %llu,\n"
                  "  \"remotes\": %zu,\n",
                  (unsigned long long)(now_ms() - g_bal.started_at),
                  (unsigned long long)g_bal.udp_queries,
@@ -1421,8 +1570,22 @@ void handle_stats() {
                  (unsigned long long)g_bal.idle_closes,
                  (unsigned long long)g_bal.client_evictions,
                  (unsigned long long)g_bal.backend_stalls,
+                 g_no_direct ? "false" : "true",
+                 (unsigned long long)g_bal.fd_passes,
+                 (unsigned long long)g_bal.direct_forwards,
+                 (unsigned long long)g_syscalls,
                  g_bal.remotes.size());
         out += line;
+        /* UDP-front recvmmsg batch sizes (log2 cells: 1, 2-3, 4-7,
+         * ..., >=128): mass above the first cell proves batching held */
+        out += "  \"udp_batch_cells\": [";
+        for (int c = 0; c < Balancer::kBatchCells; c++) {
+            snprintf(line, sizeof(line), "%s%llu",
+                     c == 0 ? "" : ", ",
+                     (unsigned long long)g_bal.udp_batch_cells[c]);
+            out += line;
+        }
+        out += "],\n";
         /* forward-RTT histogram: log2 µs upper bounds, open-ended last
          * cell — enough to localize a topology regression to the
          * backend round trip vs the balancer's own packet path */
@@ -1475,7 +1638,7 @@ void handle_stats() {
                      "    {\"id\": %d, \"path\": \"%s\", \"healthy\": %s, "
                      "\"forwarded\": %llu, \"responded\": %llu, "
                      "\"gen_known\": %s, \"gen\": %llu, "
-                     "\"wq_bytes\": %zu, "
+                     "\"wq_bytes\": %zu, \"direct\": %s, "
                      "\"remotes\": %zu}%s\n",
                      be.id, be.path.c_str(), be.healthy ? "true" : "false",
                      (unsigned long long)be.forwarded,
@@ -1483,6 +1646,7 @@ void handle_stats() {
                      be.gen_known ? "true" : "false",
                      (unsigned long long)be.gen,
                      be.conn.wq_bytes,
+                     be.fd_passed ? "true" : "false",
                      remote_counts[i],
                      i + 1 < g_bal.backends.size() ? "," : "");
             out += line;
@@ -1602,7 +1766,7 @@ void report_port() {
 
 int main(int argc, char **argv) {
     int c;
-    while ((c = getopt(argc, argv, "d:p:b:s:c:T:m:v")) != -1) {
+    while ((c = getopt(argc, argv, "d:p:b:s:c:T:m:Dv")) != -1) {
         switch (c) {
         case 'd': g_bal.sockdir = optarg; break;
         case 'p': g_bal.port = atoi(optarg); break;
@@ -1611,11 +1775,13 @@ int main(int argc, char **argv) {
         case 'c': g_bal.cache_ms = atoi(optarg); break;
         case 'T': g_bal.tcp_idle_ms = atoi(optarg); break;
         case 'm': g_bal.max_tcp_clients = atoi(optarg); break;
+        case 'D': g_no_direct = 1; break;
         case 'v': g_verbose = 1; break;
         default:
             fprintf(stderr, "usage: mbalancer -d sockdir [-p port] "
                             "[-b bindaddr] [-s scan_ms] [-c cache_ms] "
                             "[-T tcp_idle_ms] [-m max_tcp_clients] "
+                            "[-D (disable direct-return fd passing)] "
                             "[-v]\n");
             return 1;
         }
@@ -1680,6 +1846,7 @@ int main(int argc, char **argv) {
     struct epoll_event events[64];
     for (;;) {
         int n = epoll_wait(g_bal.epfd, events, 64, -1);
+        g_syscalls++;
         if (n < 0) {
             if (errno == EINTR) continue;
             perror("epoll_wait");

@@ -41,6 +41,8 @@
 
 unsigned char fastio_shared_bufs[FASTIO_BATCH][FASTIO_DGRAM_MAX];
 
+fastio_io_t fastio_io;
+
 PyObject *
 fastio_addr_to_tuple(const struct sockaddr_storage *ss)
 {
@@ -147,6 +149,7 @@ fastio_recv_batch(PyObject *self, PyObject *args)
             return PyList_New(0);
         return PyErr_SetFromErrno(PyExc_OSError);
     }
+    fastio_io_note_recv(n);
 
     PyObject *out = PyList_New(n);
     if (out == NULL)
@@ -235,6 +238,7 @@ fastio_send_batch(PyObject *self, PyObject *args)
             if (sent >= 0) {
                 /* a short count means msgs[off+sent] hit an error; the
                  * next pass re-sends from there and classifies it */
+                fastio_io_note_send(sent);
                 off += sent > 0 ? sent : 1;
                 continue;
             }
@@ -269,11 +273,48 @@ fail:
     return NULL;
 }
 
+static PyObject *
+fastio_io_stats(PyObject *self, PyObject *args)
+{
+    int reset = 0;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "|p", &reset))
+        return NULL;
+    PyObject *cells = PyList_New(FASTIO_IO_CELLS);
+    if (cells == NULL)
+        return NULL;
+    for (int i = 0; i < FASTIO_IO_CELLS; i++) {
+        PyObject *v = PyLong_FromUnsignedLongLong(fastio_io.recv_cells[i]);
+        if (v == NULL) {
+            Py_DECREF(cells);
+            return NULL;
+        }
+        PyList_SET_ITEM(cells, i, v);
+    }
+    PyObject *d = Py_BuildValue(
+        "{s:K,s:K,s:K,s:K,s:N}",
+        "recv_calls", fastio_io.recv_calls,
+        "recv_msgs", fastio_io.recv_msgs,
+        "send_calls", fastio_io.send_calls,
+        "send_msgs", fastio_io.send_msgs,
+        "recv_cells", cells);
+    if (d == NULL)
+        return NULL;
+    if (reset)
+        memset(&fastio_io, 0, sizeof(fastio_io));
+    return d;
+}
+
 static PyMethodDef fastio_methods[] = {
     {"recv_batch", fastio_recv_batch, METH_VARARGS,
      "recv_batch(fd, max_n=64) -> list[(bytes, (host, port))]"},
     {"send_batch", fastio_send_batch, METH_VARARGS,
      "send_batch(fd, msgs) -> int sent"},
+    {"io_stats", fastio_io_stats, METH_VARARGS,
+     "io_stats(reset=False) -> dict of process-wide batched-I/O "
+     "counters (recvmmsg/sendmmsg calls, messages, and the recvmmsg "
+     "batch-size log2 histogram)"},
     {"fastpath_new", fastpath_new, METH_VARARGS,
      "fastpath_new(size, expiry_ms, lat_buckets, size_buckets) -> capsule"},
     {"fastpath_put", fastpath_put, METH_VARARGS,
@@ -286,6 +327,12 @@ static PyMethodDef fastio_methods[] = {
     {"fastpath_serve_frames", fastpath_serve_frames, METH_VARARGS,
      "fastpath_serve_frames(cache, framed, gen[, client, port, proto])"
      " -> (framed_responses, consumed, [miss_payload, ...])"},
+    {"fastpath_serve_balancer", fastpath_serve_balancer, METH_VARARGS,
+     "fastpath_serve_balancer(cache, chunk, gen, fd) -> "
+     "(consumed, served, [raw_frame, ...]) — walk balancer frames in "
+     "the chunk, answer UDP-transport hits directly on the passed "
+     "(balancer-owned) fd via sendmmsg with explicit msg_name, and "
+     "surface everything else as raw frames for the Python lane"},
     {"fastpath_drain", fastpath_drain, METH_VARARGS,
      "fastpath_drain(cache, fd, gen, max_n=64) -> (misses, served)"},
     {"fastpath_stats", fastpath_stats, METH_VARARGS,

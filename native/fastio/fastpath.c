@@ -532,6 +532,182 @@ fastpath_serve_frames(PyObject *self, PyObject *args)
     return Py_BuildValue("(NnN)", resp, (Py_ssize_t)consumed, misses);
 }
 
+/* Balancer wire constants (docs/balancer-protocol.md); the Python
+ * definitions in binder_tpu/dns/server.py are authoritative. */
+#define BAL_HDR 21
+#define BAL_VERSION 1
+#define BAL_MAX_FRAME 65556
+#define BAL_TRANSPORT_UDP 0
+
+/* Flush a direct-return batch on the balancer-owned fd.  Same
+ * per-destination tolerance as the drain flush.  Returns 0, or the
+ * socket-fatal errno (positive) for the caller to surface. */
+static int
+bal_flush(int fd, struct mmsghdr *omsgs, int n_hits)
+{
+    int off = 0;
+    while (off < n_hits) {
+        int sent = sendmmsg(fd, omsgs + off, (unsigned)(n_hits - off),
+                            MSG_DONTWAIT);
+        if (sent >= 0) {
+            fastio_io_note_send(sent);
+            off += sent > 0 ? sent : 1;
+            continue;
+        }
+        if (errno == EINTR)
+            continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+            return 0;            /* buffer full: drop rest (UDP) */
+        if (errno == EBADF || errno == ENOTSOCK || errno == EFAULT ||
+            errno == ENOMEM)
+            return errno;        /* fatal: caller drops direct mode */
+        off += 1;                /* per-destination failure: skip one */
+    }
+    return 0;
+}
+
+PyObject *
+fastpath_serve_balancer(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *capsule;
+    Py_buffer data;
+    unsigned long long gen;
+    int fd;
+
+    if (!PyArg_ParseTuple(args, "Oy*Ki", &capsule, &data, &gen, &fd))
+        return NULL;
+    fp_cache_t *c = fp_from_capsule(capsule);
+    if (c == NULL) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+
+    /* direct server return: every UDP-transport hit in the chunk is
+     * answered straight onto the balancer's client-facing socket (the
+     * passed fd) with the client sockaddr from the frame as msg_name —
+     * the reply never re-enters the balancer process.  Everything else
+     * (misses, control frames, TCP transport, unknown versions)
+     * surfaces as raw frames for the Python lane. */
+    static uint8_t outs[FP_BATCH][FP_MAX_WIRE];
+    struct mmsghdr omsgs[FP_BATCH];
+    struct iovec oiovs[FP_BATCH];
+    struct sockaddr_storage oaddrs[FP_BATCH];
+    int n_hits = 0;
+    long served = 0;
+    int fatal_errno = 0;
+    memset(omsgs, 0, sizeof(omsgs));
+
+    PyObject *misses = PyList_New(0);
+    if (misses == NULL) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+
+    const uint8_t *p = (const uint8_t *)data.buf;
+    size_t n = (size_t)data.len;
+    size_t consumed = 0;
+
+    while (fatal_errno == 0 && consumed + 4 <= n) {
+        size_t flen = ((size_t)p[consumed] << 24)
+                    | ((size_t)p[consumed + 1] << 16)
+                    | ((size_t)p[consumed + 2] << 8)
+                    | (size_t)p[consumed + 3];
+        if (flen < BAL_HDR || flen > BAL_MAX_FRAME)
+            break;          /* protocol garbage: Python closes the link */
+        if (consumed + 4 + flen > n)
+            break;          /* partial frame: caller keeps the tail */
+        const uint8_t *fr = p + consumed + 4;
+        uint8_t version = fr[0], family = fr[1], transport = fr[2];
+        const uint8_t *addr = fr + 3;
+        uint16_t port = (uint16_t)(((uint16_t)fr[19] << 8) | fr[20]);
+        const uint8_t *pkt = fr + BAL_HDR;
+        size_t plen = flen - BAL_HDR;
+
+        size_t wlen = 0;
+        uint16_t qtype = 0;
+        double t0 = fp_now();
+        if (version == BAL_VERSION && (family == 4 || family == 6)
+                && transport == BAL_TRANSPORT_UDP && plen >= 12) {
+            /* logged posture: stringify the frame's client so the core
+             * can emit its line (only when the ring is armed) */
+            char client[INET6_ADDRSTRLEN];
+            fp_logsrc_t src = { NULL, port, "udp" };
+            if (c->lr.enabled
+                    && inet_ntop(family == 4 ? AF_INET : AF_INET6, addr,
+                                 client, sizeof(client)) != NULL)
+                src.client = client;
+            /* decline_tc=0: the transport is known UDP, so truncated
+             * wires replay exactly as on the direct UDP drain */
+            wlen = fp_serve_one_lx(c, pkt, plen, (uint64_t)gen, t0,
+                                   outs[n_hits], &qtype, 0,
+                                   src.client != NULL ? &src : NULL);
+        }
+        if (wlen == 0) {
+            PyObject *raw = PyBytes_FromStringAndSize(
+                (const char *)fr, (Py_ssize_t)flen);
+            int rc = raw == NULL ? -1 : PyList_Append(misses, raw);
+            Py_XDECREF(raw);
+            if (rc < 0) {
+                Py_DECREF(misses);
+                PyBuffer_Release(&data);
+                return NULL;
+            }
+        } else {
+            struct sockaddr_storage *ss = &oaddrs[n_hits];
+            socklen_t alen;
+            memset(ss, 0, sizeof(*ss));
+            if (family == 4) {
+                struct sockaddr_in *sa = (struct sockaddr_in *)ss;
+                sa->sin_family = AF_INET;
+                memcpy(&sa->sin_addr, addr, 4);
+                sa->sin_port = htons(port);
+                alen = sizeof(*sa);
+            } else {
+                struct sockaddr_in6 *sa6 = (struct sockaddr_in6 *)ss;
+                sa6->sin6_family = AF_INET6;
+                memcpy(&sa6->sin6_addr, addr, 16);
+                sa6->sin6_port = htons(port);
+                alen = sizeof(*sa6);
+            }
+            oiovs[n_hits].iov_base = outs[n_hits];
+            oiovs[n_hits].iov_len = wlen;
+            omsgs[n_hits].msg_hdr.msg_iov = &oiovs[n_hits];
+            omsgs[n_hits].msg_hdr.msg_iovlen = 1;
+            omsgs[n_hits].msg_hdr.msg_name = ss;
+            omsgs[n_hits].msg_hdr.msg_namelen = alen;
+            n_hits++;
+            served++;
+            /* same per-qtype accounting as serve_wire */
+            fp_qstat_t *qs = fp_qstat(c, qtype);
+            double elapsed = fp_now() - t0;
+            qs->count++;
+            qs->lat_sum += elapsed;
+            qs->lat_cells[fp_bucket_index(c->lat_buckets,
+                                          c->n_lat_buckets, elapsed)]++;
+            qs->size_sum += (double)wlen;
+            qs->size_cells[fp_bucket_index(c->size_buckets,
+                                           c->n_size_buckets,
+                                           (double)wlen)]++;
+            if (n_hits == FP_BATCH) {
+                fatal_errno = bal_flush(fd, omsgs, n_hits);
+                n_hits = 0;
+                memset(omsgs, 0, sizeof(omsgs));
+            }
+        }
+        consumed += 4 + flen;
+    }
+    if (fatal_errno == 0 && n_hits > 0)
+        fatal_errno = bal_flush(fd, omsgs, n_hits);
+    PyBuffer_Release(&data);
+    if (fatal_errno != 0) {
+        Py_DECREF(misses);
+        errno = fatal_errno;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    return Py_BuildValue("(nlN)", (Py_ssize_t)consumed, served, misses);
+}
+
 PyObject *
 fastpath_zone_reserve(PyObject *self, PyObject *args)
 {
@@ -616,6 +792,7 @@ fastpath_drain(PyObject *self, PyObject *args)
         }
         return PyErr_SetFromErrno(PyExc_OSError);
     }
+    fastio_io_note_recv(n);
 
     PyObject *misses = PyList_New(0);
     if (misses == NULL)
@@ -694,6 +871,7 @@ fastpath_drain(PyObject *self, PyObject *args)
         int sent = sendmmsg(fd, omsgs + off, (unsigned)(n_hits - off),
                             MSG_DONTWAIT);
         if (sent >= 0) {
+            fastio_io_note_send(sent);
             off += sent > 0 ? sent : 1;
             continue;
         }

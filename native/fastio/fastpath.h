@@ -16,6 +16,43 @@
 /* fastio.c */
 PyObject *fastio_addr_to_tuple(const struct sockaddr_storage *ss);
 
+/* Process-wide I/O accounting shared by every batched entry point
+ * (recv_batch, send_batch, fastpath_drain, fastpath_serve_balancer).
+ * The batch-size histogram is the observable for "sampling must not
+ * defeat batching": if the duty-cycle sampler serialized the drain,
+ * every cell above recv_cells[0] would empty out. */
+#define FASTIO_IO_CELLS 8   /* log2 cells: 1, 2-3, 4-7, ..., >=128 */
+typedef struct {
+    unsigned long long recv_calls;   /* recvmmsg calls that returned >0 */
+    unsigned long long recv_msgs;
+    unsigned long long recv_cells[FASTIO_IO_CELLS];
+    unsigned long long send_calls;   /* sendmmsg calls that sent >0 */
+    unsigned long long send_msgs;
+} fastio_io_t;
+extern fastio_io_t fastio_io;
+
+static inline void
+fastio_io_note_recv(int n)
+{
+    if (n <= 0)
+        return;
+    fastio_io.recv_calls++;
+    fastio_io.recv_msgs += (unsigned long long)n;
+    int cell = 0;
+    while (cell < FASTIO_IO_CELLS - 1 && (1 << (cell + 1)) <= n)
+        cell++;
+    fastio_io.recv_cells[cell]++;
+}
+
+static inline void
+fastio_io_note_send(int n)
+{
+    if (n <= 0)
+        return;
+    fastio_io.send_calls++;
+    fastio_io.send_msgs += (unsigned long long)n;
+}
+
 /* receive arena shared by recv_batch and fastpath_drain — only one of
  * them runs at a time (both hold the GIL for the whole call), and a
  * process uses one or the other per readiness event; sharing saves ~4MB
@@ -28,6 +65,7 @@ PyObject *fastpath_put(PyObject *self, PyObject *args);
 PyObject *fastpath_zone_put(PyObject *self, PyObject *args);
 PyObject *fastpath_serve_wire(PyObject *self, PyObject *args);
 PyObject *fastpath_serve_frames(PyObject *self, PyObject *args);
+PyObject *fastpath_serve_balancer(PyObject *self, PyObject *args);
 PyObject *fastpath_drain(PyObject *self, PyObject *args);
 PyObject *fastpath_stats(PyObject *self, PyObject *args);
 PyObject *fastpath_clear(PyObject *self, PyObject *args);

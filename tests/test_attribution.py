@@ -368,9 +368,11 @@ class TestBalancerStageCounters:
                 balancer_socket=os.path.join(sockdir, "0"),
                 collector=MetricsCollector(), query_log=False)
             await backend.start()
+            # -D pins the compat relay lane: this test asserts the
+            # probe/relay stage counters, which direct return bypasses
             proc = await asyncio.create_subprocess_exec(
                 BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
-                "-s", "150", "-c", "60000",
+                "-s", "150", "-c", "60000", "-D",
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.DEVNULL)
             try:

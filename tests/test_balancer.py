@@ -50,10 +50,16 @@ async def start_backend(sockdir, instance, tag):
 
 
 async def start_balancer(sockdir, scan_ms=150, cache_ms=60000,
-                         bind="127.0.0.1"):
+                         bind="127.0.0.1", direct=True):
+    # direct=False pins the compat relay lane (-D): tests asserting the
+    # balancer's own answer-cache behavior need replies to flow back
+    # through it, which direct return bypasses by design
+    args = [BALANCER, "-d", sockdir, "-p", "0", "-b", bind,
+            "-s", str(scan_ms), "-c", str(cache_ms)]
+    if not direct:
+        args.append("-D")
     proc = await asyncio.create_subprocess_exec(
-        BALANCER, "-d", sockdir, "-p", "0", "-b", bind,
-        "-s", str(scan_ms), "-c", str(cache_ms),
+        *args,
         stdout=asyncio.subprocess.PIPE,
         stderr=asyncio.subprocess.DEVNULL)
     # generous deadline: on a loaded single-core box (bench processes,
@@ -255,7 +261,7 @@ class TestBalancerCache:
                                                                "0"),
                                   collector=MetricsCollector())
             await server.start()
-            proc, port = await start_balancer(sockdir)
+            proc, port = await start_balancer(sockdir, direct=False)
             try:
                 await asyncio.sleep(0.4)
                 for i in range(5):
@@ -321,7 +327,7 @@ class TestBalancerCache:
                                                                "0"),
                                   collector=MetricsCollector())
             await server.start()
-            proc, port = await start_balancer(sockdir)
+            proc, port = await start_balancer(sockdir, direct=False)
             try:
                 await asyncio.sleep(0.4)
                 orderings = []
@@ -369,7 +375,7 @@ class TestBalancerCache:
                                                                "0"),
                                   collector=MetricsCollector())
             await server.start()
-            proc, port = await start_balancer(sockdir)
+            proc, port = await start_balancer(sockdir, direct=False)
             try:
                 await asyncio.sleep(0.4)
                 loop = asyncio.get_running_loop()
@@ -453,7 +459,8 @@ class TestBalancerV6:
 
         async def run():
             b1 = await start_backend(sockdir, 5301, 1)
-            proc, port = await start_balancer(sockdir, cache_ms=150)
+            proc, port = await start_balancer(sockdir, cache_ms=150,
+                                               direct=False)
             try:
                 await asyncio.sleep(0.4)
                 for qid in (1, 2):
@@ -723,3 +730,157 @@ def test_ephemeral_pair_bind_survives_tcp_squatters(tmp_path):
                 s.close()
 
     asyncio.run(run())
+
+
+class TestFrontedByteParity:
+    """ISSUE 18: answers through the balancer must be byte-identical
+    to direct serving — on BOTH fronted lanes.  UDP rides direct
+    return (the backend answers on the balancer's passed socket), TCP
+    rides the relay (the client's TCP connection terminates inside the
+    balancer), and neither transformation may touch the DNS payload:
+    same truncation decision (TC=1 at the classic 512 limit — the
+    frame's transport byte carries UDP semantics to the backend), same
+    flags, same records.  Queries use identical qids on both paths so
+    "modulo ID" reduces to exact equality."""
+
+    @staticmethod
+    def _fat_fixture(tag):
+        # web = single deterministic answer (exact-bytes compare);
+        # svc = 40 lb addresses, >512b without EDNS -> TC=1 on UDP
+        store = FakeStore()
+        cache = MirrorCache(store, DOMAIN)
+        store.put_json("/com/foo/web",
+                       {"type": "host",
+                        "host": {"address": f"10.42.0.{tag}"}})
+        store.put_json("/com/foo/svc", {
+            "type": "service",
+            "service": {"srvce": "_pg", "proto": "_tcp", "port": 5432}})
+        for i in range(40):
+            store.put_json(f"/com/foo/svc/lb{i}",
+                           {"type": "load_balancer",
+                            "load_balancer": {"address": f"10.77.0.{i + 1}"}})
+        store.start_session()
+        return cache
+
+    async def _start_fat_backend(self, sockdir, instance, tag):
+        server = BinderServer(
+            zk_cache=self._fat_fixture(tag), dns_domain=DOMAIN,
+            datacenter_name="dc0", host="127.0.0.1", port=0,
+            balancer_socket=os.path.join(sockdir, str(instance)),
+            collector=MetricsCollector())
+        await server.start()
+        return server
+
+    @staticmethod
+    async def _raw_udp_ask(port, wire, timeout=5.0):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        class Proto(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                transport.sendto(wire)
+
+            def datagram_received(self, data, addr):
+                if not fut.done():
+                    fut.set_result(data)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, remote_addr=("127.0.0.1", port))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            transport.close()
+
+    @staticmethod
+    async def _raw_tcp_ask(port, wire, timeout=5.0):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            writer.write(struct.pack(">H", len(wire)) + wire)
+            await writer.drain()
+            (ln,) = struct.unpack(">H", await asyncio.wait_for(
+                reader.readexactly(2), timeout))
+            return await reader.readexactly(ln)
+        finally:
+            writer.close()
+            await writer.wait_closed()
+
+    @staticmethod
+    async def _wait_direct(sockdir, timeout=10.0):
+        # parity through the direct lane is only meaningful once the
+        # fd pass has actually happened — otherwise the ask would ride
+        # the relay and the test would vacuously pass
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            try:
+                stats = read_stats(sockdir)
+                if any(b.get("direct") for b in stats.get("backends", [])):
+                    return stats
+            except (OSError, ValueError):
+                pass
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError("fd pass never completed")
+            await asyncio.sleep(0.1)
+
+    def test_fronted_lanes_byte_identical_to_direct(self, tmp_path):
+        async def run():
+            sockdir = str(tmp_path)
+            backend = await self._start_fat_backend(sockdir, 5301, 7)
+            proc, fport = await start_balancer(sockdir)
+            try:
+                await self._wait_direct(sockdir)
+
+                # -- UDP, single answer (direct-return lane) --
+                q = make_query("web.foo.com", Type.A, qid=41).encode()
+                via_bal = await self._raw_udp_ask(fport, q)
+                direct = await self._raw_udp_ask(backend.udp_port, q)
+                assert via_bal == direct
+                m = Message.decode(via_bal)
+                assert not m.tc and len(m.answers) == 1
+
+                # the answer really came over the passed socket, not
+                # the relay fallback
+                stats = read_stats(sockdir)
+                assert stats["direct_forwards"] >= 1
+                assert stats["fd_passes"] >= 1
+
+                # -- UDP, no EDNS, >512b answer: TC=1 both ways --
+                q = make_query("svc.foo.com", Type.A, qid=42,
+                               edns_payload=None).encode()
+                via_bal = await self._raw_udp_ask(fport, q)
+                direct = await self._raw_udp_ask(backend.udp_port, q)
+                assert via_bal == direct
+                m = Message.decode(via_bal)
+                assert m.tc and not m.answers
+
+                # -- TCP (relay lane): full-size answers --
+                q = make_query("web.foo.com", Type.A, qid=43).encode()
+                via_bal = await self._raw_tcp_ask(fport, q)
+                direct = await self._raw_tcp_ask(backend.tcp_port, q)
+                assert via_bal == direct
+                m = Message.decode(via_bal)
+                assert not m.tc and len(m.answers) == 1
+
+                # TCP svc: no truncation on the stream lane; answer
+                # sets match (order-insensitive — multi-answer
+                # responses rotate independently per query)
+                q = make_query("svc.foo.com", Type.A, qid=44).encode()
+                via_bal = await self._raw_tcp_ask(fport, q)
+                direct = await self._raw_tcp_ask(backend.tcp_port, q)
+                mb, md = Message.decode(via_bal), Message.decode(direct)
+                assert not mb.tc and not md.tc
+                assert len(mb.answers) == 40 and len(md.answers) == 40
+                def rdatas(msg):
+                    out = []
+                    for r in msg.answers:
+                        buf = bytearray()
+                        r.encode_rdata(buf, {})
+                        out.append(bytes(buf))
+                    return sorted(out)
+
+                assert rdatas(mb) == rdatas(md)
+            finally:
+                proc.kill()
+                await proc.wait()
+                await backend.stop()
+
+        asyncio.run(run())

@@ -79,7 +79,7 @@ def test_zk_to_balancer_full_chain(tmp_path):
         assert await wait_for(lambda: all(
             c.lookup("web.foo.com") is not None for _, c, _s in backends))
 
-        proc, port = await start_balancer(sockdir)
+        proc, port = await start_balancer(sockdir, direct=False)
         try:
             await asyncio.sleep(0.4)
 
@@ -172,7 +172,7 @@ def test_balancer_invalidation_is_per_name(tmp_path):
             lambda: cache.lookup("api.foo.com") is not None
             and cache.lookup("api.foo.com").data is not None)
 
-        proc, port = await start_balancer(sockdir)
+        proc, port = await start_balancer(sockdir, direct=False)
         try:
             await asyncio.sleep(0.4)
             # fill the balancer cache for both names
@@ -255,7 +255,7 @@ def test_recursion_through_balancer_not_cached(tmp_path):
                              collector=MetricsCollector())
         await local.start()
 
-        proc, port = await start_balancer(sockdir)
+        proc, port = await start_balancer(sockdir, direct=False)
         try:
             await asyncio.sleep(0.4)
             # local name: cacheable as usual

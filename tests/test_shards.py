@@ -435,6 +435,20 @@ class TestLargeSnapshotAttach:
         asyncio.run(run())
 
 
+class TestShardAuto:
+    def test_auto_resolves_to_core_count(self):
+        from binder_tpu.config.options import parse_options
+        from binder_tpu.main import resolve_shard_count
+        opts = parse_options(["--shards", "auto", "-f",
+                              "etc/config.json"])
+        assert opts["shards"] == "auto"
+        n = resolve_shard_count(opts)
+        assert n == (os.cpu_count() or 1) and n >= 1
+        # explicit counts and the unset default pass through untouched
+        assert resolve_shard_count({"shards": 3}) == 3
+        assert resolve_shard_count({}) == 0
+
+
 class TestChaosShardKill:
     def test_dsl_parses_and_dispatches(self):
         plan = FaultPlan.parse("at 0.5 shard-kill shard=1\n"

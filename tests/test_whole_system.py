@@ -141,7 +141,11 @@ def test_everything_at_once(tmp_path, query_log, json_log):
             and c.lookup("api.foo.com").data is not None
             for _cl, c, _r, _s in backends))
 
-        proc, port = await start_balancer(sockdir)
+        # relay lane (-D): this scenario asserts the balancer's own
+        # cache fill/invalidation counters, which direct return
+        # bypasses by design (tools/balancer_smoke.py and
+        # tests/test_balancer.py cover the direct lane)
+        proc, port = await start_balancer(sockdir, direct=False)
         try:
             await asyncio.sleep(0.4)
 

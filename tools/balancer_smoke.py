@@ -1,0 +1,292 @@
+#!/usr/bin/env python3
+"""Balancer-fronted end-to-end smoke: direct return + backend churn.
+
+Boots the REAL mbalancer binary (native/balancer) in front of two
+in-process backends speaking the balancer socket protocol, then, while
+driving continuous UDP load at the balancer's client port, asserts the
+compatibility lane's operational invariants end to end
+(docs/balancer-protocol.md, ISSUE 18):
+
+- the direct-return negotiation completes (fd passed to every
+  connected backend, ``direct_forwards`` advancing — replies leave on
+  the balancer's own client socket without re-entering it);
+- a mid-stream backend departure (stop + socket unlink, the SIGTERM
+  semantics) costs no client-visible timeouts: every query is
+  answered within its retry budget while affinity is re-pointed at
+  the survivor;
+- the departed instance coming BACK is re-adopted on the next scan:
+  connection re-established, direct return renegotiated
+  (``fd_passes`` advances past the initial pass count), both
+  backends healthy;
+- the stats-socket counters stay monotonic across the churn — stage
+  cycles/ops, ``udp_queries``, ``direct_forwards``, and the recvmmsg
+  batch histogram never regress (a balancer that resets attribution
+  on backend loss would corrupt every cross-incident comparison).
+
+Run via ``make balancer-smoke`` (30 s) or set
+``BINDER_BALANCER_SECONDS``.  Prints one JSON summary line; exit 0 ==
+all invariants held.
+"""
+import asyncio
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from binder_tpu.dns import Message, Rcode, Type, make_query  # noqa: E402
+from binder_tpu.metrics.collector import MetricsCollector  # noqa: E402
+from binder_tpu.server import BinderServer  # noqa: E402
+from binder_tpu.store import FakeStore, MirrorCache  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BALANCER = os.environ.get("BINDER_BALANCER") or os.path.join(
+    ROOT, "native", "build", "mbalancer")
+DOMAIN = "balsmoke.test"
+
+
+class Violation(Exception):
+    pass
+
+
+def _fixture(tag: int) -> MirrorCache:
+    store = FakeStore()
+    cache = MirrorCache(store, DOMAIN)
+    # the answer address encodes which backend served the query, so
+    # the failover assertion can watch affinity move
+    store.put_json("/test/balsmoke/web",
+                   {"type": "host", "host": {"address": f"10.44.0.{tag}"}})
+    store.start_session()
+    return cache
+
+
+async def _start_backend(sockdir: str, instance: int) -> BinderServer:
+    server = BinderServer(
+        zk_cache=_fixture(instance), dns_domain=DOMAIN,
+        datacenter_name="dc0", host="127.0.0.1", port=0,
+        balancer_socket=os.path.join(sockdir, str(instance)),
+        collector=MetricsCollector(), query_log=False)
+    await server.start()
+    return server
+
+
+async def _start_balancer(sockdir: str):
+    proc = await asyncio.create_subprocess_exec(
+        BALANCER, "-d", sockdir, "-p", "0", "-b", "127.0.0.1",
+        "-s", "150", "-c", "0",
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.DEVNULL)
+    line = await asyncio.wait_for(proc.stdout.readline(), 30)
+    if not line.startswith(b"PORT "):
+        raise Violation(f"mbalancer announce: {line!r}")
+    return proc, int(line.split()[1])
+
+
+def _read_stats(sockdir: str) -> dict:
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.settimeout(5)
+    try:
+        c.connect(os.path.join(sockdir, ".balancer.stats"))
+        buf = b""
+        while True:
+            chunk = c.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+    finally:
+        c.close()
+    return json.loads(buf)
+
+
+def _monotone_keys(stats: dict) -> dict:
+    """The counters that must never regress across backend churn."""
+    flat = {"udp_queries": stats["udp_queries"],
+            "tcp_queries": stats["tcp_queries"],
+            "fd_passes": stats["fd_passes"],
+            "direct_forwards": stats["direct_forwards"],
+            "syscalls": stats["syscalls"]}
+    for i, c in enumerate(stats.get("udp_batch_cells", [])):
+        flat[f"udp_batch_cells[{i}]"] = c
+    for stage, cell in (stats.get("stage_cycles") or {}).items():
+        flat[f"stage.{stage}.cycles"] = cell.get("cycles", 0)
+        flat[f"stage.{stage}.ops"] = cell.get("ops", 0)
+    return flat
+
+
+def _check_monotone(prev: dict, cur: dict, where: str) -> None:
+    for k, v in cur.items():
+        if k in prev and v < prev[k]:
+            raise Violation(
+                f"counter {k} regressed {prev[k]} -> {v} ({where})")
+
+
+async def _ask(port: int, qid: int, timeout: float = 2.0):
+    """One query with a 3-try retry budget on a fresh socket.  A lost
+    in-flight packet during the kill window costs a retry; a query
+    that exhausts the budget is the client-visible timeout the smoke
+    exists to rule out."""
+    loop = asyncio.get_running_loop()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.setblocking(False)
+    sock.connect(("127.0.0.1", port))
+    wire = make_query(f"web.{DOMAIN}", Type.A, qid=qid).encode()
+    try:
+        for attempt in range(3):
+            sock.send(wire)
+            try:
+                data = await asyncio.wait_for(
+                    loop.sock_recv(sock, 4096), timeout)
+                return data, attempt
+            except asyncio.TimeoutError:
+                continue
+        raise Violation(f"query qid={qid} unanswered after 3 tries "
+                        f"(client-visible timeout)")
+    finally:
+        sock.close()
+
+
+async def run_incident(duration: float) -> dict:
+    sockdir = tempfile.mkdtemp(prefix="bal-smoke-")
+    b0 = await _start_backend(sockdir, 1)
+    b1 = await _start_backend(sockdir, 2)
+    backends = {1: b0, 2: b1}
+    proc, port = await _start_balancer(sockdir)
+    stats_out = {"queries": 0, "retries": 0}
+    try:
+        # wait for both connections + the direct-return fd passes
+        deadline = time.monotonic() + 15
+        while True:
+            try:
+                stats = _read_stats(sockdir)
+                bes = stats.get("backends", [])
+                if (len(bes) == 2 and all(b["healthy"] for b in bes)
+                        and all(b.get("direct") for b in bes)):
+                    break
+            except (OSError, ValueError):
+                pass
+            if time.monotonic() > deadline:
+                raise Violation("backends never adopted direct return")
+            await asyncio.sleep(0.1)
+        fd_passes0 = stats["fd_passes"]
+        if fd_passes0 < 2:
+            raise Violation(f"expected >=2 fd passes, got {fd_passes0}")
+
+        kill_at = max(1.0, duration * 0.35)
+        revive_at = max(2.0, duration * 0.6)
+        t0 = time.monotonic()
+        t_end = t0 + duration
+        prev = _monotone_keys(stats)
+        served_tags = set()
+        killed = revived = None
+        i = 0
+        while time.monotonic() < t_end:
+            i += 1
+            now = time.monotonic() - t0
+            data, retries = await _ask(port, qid=(i % 0xFFFF) + 1)
+            stats_out["queries"] += 1
+            stats_out["retries"] += retries
+            msg = Message.decode(data)
+            if msg.rcode != Rcode.NOERROR or not msg.answers:
+                raise Violation(f"bad answer rcode={msg.rcode}")
+            tag = int(msg.answers[0].address.rsplit(".", 1)[1])
+            served_tags.add(tag)
+
+            if killed is None and now >= kill_at:
+                # mid-stream departure of the backend that owns the
+                # load: SIGTERM semantics = stop + unlink the socket
+                killed = tag
+                victim = backends[tag]
+                path = victim.balancer_socket
+                await victim.stop()
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+            elif killed is not None and revived is None \
+                    and now >= revive_at:
+                # the departed instance returns; the next scan must
+                # re-adopt it and renegotiate direct return
+                backends[killed] = await _start_backend(sockdir, killed)
+                revived = killed
+
+            if i % 25 == 0:
+                cur = _monotone_keys(_read_stats(sockdir))
+                _check_monotone(prev, cur, f"t+{now:.1f}s")
+                prev = cur
+            await asyncio.sleep(duration / 2000.0)
+
+        if killed is None:
+            raise Violation("duration too short: kill never happened")
+        if len(served_tags) < 2:
+            raise Violation(f"affinity never moved off backend "
+                            f"{killed} after its departure")
+
+        # post-churn: both backends healthy, direct return renegotiated
+        # on the revived connection, counters still monotone
+        deadline = time.monotonic() + 10
+        while True:
+            stats = _read_stats(sockdir)
+            bes = stats.get("backends", [])
+            if (revived is not None and len(bes) == 2
+                    and all(b["healthy"] for b in bes)
+                    and all(b.get("direct") for b in bes)):
+                break
+            if time.monotonic() > deadline:
+                raise Violation(f"revived backend not re-adopted: "
+                                f"{bes}")
+            await asyncio.sleep(0.2)
+        _check_monotone(prev, _monotone_keys(stats), "post-churn")
+        if stats["fd_passes"] <= fd_passes0:
+            raise Violation("direct return not renegotiated after "
+                            "backend revival")
+        if stats["direct_forwards"] <= 0:
+            raise Violation("no direct-return forwards recorded")
+
+        stats_out.update({
+            "duration_s": duration,
+            "killed_backend": killed,
+            "served_tags": sorted(served_tags),
+            "fd_passes": stats["fd_passes"],
+            "direct_forwards": stats["direct_forwards"],
+            "udp_queries": stats["udp_queries"],
+            "syscalls_per_query": round(
+                stats["syscalls"] / stats["udp_queries"], 3)
+            if stats["udp_queries"] else None,
+        })
+        return stats_out
+    finally:
+        proc.kill()
+        await proc.wait()
+        for b in backends.values():
+            try:
+                await b.stop()
+            except Exception:
+                pass
+
+
+def run_smoke(duration: float = None) -> dict:
+    if duration is None:
+        duration = float(os.environ.get("BINDER_BALANCER_SECONDS", "30"))
+    return asyncio.run(run_incident(duration))
+
+
+def main() -> int:
+    if not os.path.exists(BALANCER):
+        print(json.dumps({"ok": False,
+                          "error": "mbalancer not built (make -C native)"}))
+        return 1
+    try:
+        stats = run_smoke()
+    except Violation as e:
+        print(json.dumps({"ok": False, "violation": str(e)}))
+        return 1
+    print(json.dumps({"ok": True, **stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
