@@ -5,7 +5,8 @@ PY ?= python3
 
 .PHONY: all native test check ci bench bench-smoke status-smoke \
 	chaos-smoke tcp-smoke shard-smoke zone-smoke federation-smoke \
-	hostile-smoke verify-smoke balancer-smoke real-tiers clean
+	hostile-smoke verify-smoke balancer-smoke population-smoke \
+	real-tiers clean
 
 all: native
 
@@ -60,6 +61,7 @@ ci:
 	BINDER_HOSTILE_SECONDS=10 $(MAKE) hostile-smoke
 	BINDER_VERIFY_SECONDS=10 $(MAKE) verify-smoke
 	BINDER_BALANCER_SECONDS=10 $(MAKE) balancer-smoke
+	BINDER_POPULATION_SECONDS=10 $(MAKE) population-smoke
 	@echo "ci: all gates passed"
 
 # one fast reduced-iteration bench pass proving the measured paths still
@@ -70,6 +72,7 @@ bench-smoke: native
 	BENCH_QUERIES=5000 BENCH_PASSES=1 BENCH_MISS_QUERIES=2000 \
 		BENCH_RECURSION_QUERIES=2000 BENCH_TCP1_QUERIES=1500 \
 		BENCH_TC_FLOWS=300 BENCH_SHARD_NS=1,2 \
+		BENCH_POPULATION_SECONDS=8 \
 		BENCH_BASELINE_FILE=.scratch/bench_smoke_baseline.json \
 		$(PY) bench.py
 
@@ -150,6 +153,15 @@ hostile-smoke:
 # duration (make ci trims to 10 s)
 balancer-smoke:
 	$(PY) tools/balancer_smoke.py
+
+# million-client realism smoke: the Zipf/NAT'd-farm population model
+# vs RRL v2 (goodput floor, measured false-positive ceiling, adaptive
+# buckets + allowlist engaged), then a 2-shard rolling drain-and-
+# replace under a scripted rrl-flood — chaos worker-roll AND SIGHUP
+# config-reload, zero probe-query loss (docs/operations.md);
+# BINDER_POPULATION_SECONDS overrides the budget (make ci trims to 10)
+population-smoke:
+	$(PY) tools/population_smoke.py
 
 # serving-plane verification smoke: clean soak (zero violations while
 # the checker, audit and propagation tracer all do real work, RSS

@@ -20,6 +20,7 @@ import os
 import re
 import select
 import shutil
+import signal
 import socket as _socket_mod
 import subprocess
 import sys
@@ -2466,6 +2467,188 @@ def _bench_verify(tmpdir: str) -> Dict[str, object]:
     }
 
 
+N_POPULATION_SECONDS = float(
+    os.environ.get("BENCH_POPULATION_SECONDS", "16"))
+POPULATION_IDENTITIES = int(
+    os.environ.get("BENCH_POPULATION_IDENTITIES", "100000"))
+POPULATION_QPS_PEAK = int(
+    os.environ.get("BENCH_POPULATION_QPS", "1500"))
+#: aggregate limit low enough that a NAT'd farm overdraws it — the
+#: false-positive mechanism the adaptive arm must fix (same posture
+#: tools/population_smoke.py pins)
+POPULATION_RRL = {"responsesPerSecond": 60, "burst": 120,
+                  "slipRatio": 2, "adaptEvidence": 3,
+                  "allowlist": ["127.10.0.0/16"]}
+
+
+def _bench_population(tmpdir: str) -> Dict[str, object]:
+    """Population axis (ISSUE 19): million-client realism figures.
+
+    Three headline numbers:
+
+    - ``goodput_ratio`` — NAT'd-farm goodput (answered + TCP-retry
+      completions over sent) under the Zipf/NAT population model
+      (tools/population.py) with adaptive RRL;
+    - ``fp_rate_adaptive`` vs ``fp_rate_static`` — the measured RRL
+      false-positive rate with adaptive bucket sizing on vs off,
+      interleaved A-B-A-B in one window so box drift cancels out of
+      the comparison (the balancer-overhead pattern);
+    - ``roll.query_loss`` — closed-loop probe queries fully lost
+      across a SIGHUP-triggered 2-shard rolling drain-and-replace
+      (acceptance: zero; a bounded retry is tolerated, a loss is not).
+    """
+    from tools.population import run_population
+
+    fixture = os.path.join(tmpdir, "population_fixture.json")
+    with open(fixture, "w") as f:
+        json.dump(FIXTURE, f)
+    names = ["web.bench.com", "svc.bench.com"]
+
+    def boot(tag: str, adaptive: bool, shards: int = 0,
+             allowlist=None):
+        config = os.path.join(tmpdir, f"population_{tag}.json")
+        rrl = dict(POPULATION_RRL)
+        rrl["adaptive"] = adaptive
+        if allowlist is not None:
+            rrl["allowlist"] = list(allowlist)
+        with open(config, "w") as f:
+            json.dump({
+                "dnsDomain": "bench.com", "datacenterName": "dc0",
+                "host": "127.0.0.1",
+                "store": {"backend": "fake", "fixture": fixture},
+                "queryLog": False, "rrl": rrl,
+                **({"shards": shards} if shards else {}),
+            }, f)
+        return _launch_server(config)
+
+    # -- interleaved A/B: adaptive vs static buckets --
+    seg = max(2.0, N_POPULATION_SECONDS / 4)
+    arms: Dict[str, list] = {"adaptive": [], "static": []}
+    scrapes: Dict[str, list] = {"adaptive": [], "static": []}
+    for idx, arm in enumerate(("adaptive", "static",
+                               "adaptive", "static")):
+        proc = boot(f"{arm}{idx}", arm == "adaptive")
+        try:
+            port, mport = wait_for_ports(proc)
+            # same seed per arm pass: both postures face the SAME
+            # offered population, so the FP delta is the mechanism
+            rep = run_population(
+                "127.0.0.1", port, duration=seg, names=names,
+                domain="bench.com", identities=POPULATION_IDENTITIES,
+                qps_floor=300, qps_peak=POPULATION_QPS_PEAK,
+                seed=7 + idx // 2)
+            arms[arm].append(rep)
+            try:
+                scrapes[arm].append(_scrape_rrl(mport))
+            except Exception as e:  # noqa: BLE001 — supplementary
+                print(f"bench: population rrl scrape failed: {e!r}",
+                      file=sys.stderr)
+        finally:
+            _reap(proc)
+
+    def mean(arm: str, key: str) -> float:
+        vals = [r[key] for r in arms[arm]]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def scraped(arm: str, key: str) -> float:
+        return sum(s.get(key, 0.0) for s in scrapes[arm])
+
+    if not arms["adaptive"] or not arms["static"]:
+        raise RuntimeError("population axis: an A/B arm never ran")
+    # the adaptive arm must actually have adapted — otherwise the A/B
+    # compares identical mechanisms and the delta is pure noise
+    if scrapes["adaptive"] and not scraped("adaptive",
+                                           "adaptations_total"):
+        raise RuntimeError("population axis: adaptive arm recorded "
+                           "zero adaptations")
+
+    # -- rolling-upgrade probe loss (2 shards, SIGHUP entry) --
+    roll: Dict[str, object] = {}
+    proc = boot("roll", True, shards=2, allowlist=["127.0.0.0/24"])
+    try:
+        port, mport = wait_for_ports(proc)
+        probe_wire = make_query(names[0], Type.A, qid=77).encode()
+        sent = lost = retried = 0
+        signalled = False
+        rolls_total = 0
+        deadline = time.time() + max(10.0, N_POPULATION_SECONDS)
+        while time.time() < deadline:
+            tries = 0
+            for attempt in range(3):
+                s = _socket_mod.socket(_socket_mod.AF_INET,
+                                       _socket_mod.SOCK_DGRAM)
+                s.settimeout(1.0)
+                s.connect(("127.0.0.1", port))
+                try:
+                    s.send(probe_wire)
+                    s.recv(4096)
+                    tries = attempt + 1
+                    break
+                except _socket_mod.timeout:
+                    continue
+                finally:
+                    s.close()
+            sent += 1
+            if tries == 0:
+                lost += 1
+            elif tries > 1:
+                retried += 1
+            if sent == 20 and not signalled:
+                proc.send_signal(signal.SIGHUP)
+                signalled = True
+            if sent % 10 == 0:
+                snap = _shard_status(mport)["shards"]
+                rolls_total = snap["rolls_total"]
+                roll["roll_aborts"] = snap["roll_aborts"]
+                if rolls_total >= 2:
+                    break
+            time.sleep(0.01)
+        if rolls_total < 2:
+            raise RuntimeError("population axis: rolling upgrade did "
+                               f"not complete ({rolls_total}/2 shards)")
+        roll.update({"probes": sent, "query_loss": lost,
+                     "retried": retried, "rolls_total": rolls_total})
+    finally:
+        _reap(proc)
+
+    shape = arms["adaptive"][0]["population"]
+    fp_adaptive = mean("adaptive", "rrl_false_positive_rate")
+    fp_static = mean("static", "rrl_false_positive_rate")
+    return {
+        "identities": shape["identities"],
+        "prefixes": shape["prefixes"],
+        "zipf_s": shape["zipf_s"],
+        "nat_fan_in": shape["nat_fan_in"],
+        "offered_qps_peak": POPULATION_QPS_PEAK,
+        "segment_s": round(seg, 1),
+        # headline 1: farm goodput under adaptive RRL
+        "goodput_ratio": round(mean("adaptive", "farm_goodput_ratio"),
+                               4),
+        "goodput_ratio_static": round(
+            mean("static", "farm_goodput_ratio"), 4),
+        # headline 2: measured FP rate, adaptive vs static (A/B)
+        "fp_rate_adaptive": round(fp_adaptive, 4),
+        "fp_rate_static": round(fp_static, 4),
+        "fp_rate_delta": round(fp_static - fp_adaptive, 4),
+        # headline 3: rolling-upgrade probe loss (acceptance: zero)
+        "roll": roll,
+        "rrl": {
+            "adaptations": scraped("adaptive", "adaptations_total"),
+            "adapted_buckets": scraped("adaptive", "adapted_buckets"),
+            "allowlisted": scraped("adaptive", "allowlisted_total"),
+            "false_positives": scraped("adaptive",
+                                       "false_positives_total"),
+        },
+        "arms": {
+            arm: [{"goodput": r["farm_goodput_ratio"],
+                   "fp_rate": r["rrl_false_positive_rate"],
+                   "outcomes": r["identity_outcomes"]}
+                  for r in arms[arm]]
+            for arm in ("adaptive", "static")
+        },
+    }
+
+
 def _try_axis(name: str, fn, retries: int = 1):
     """Run one bench axis, retrying once on failure: every axis is
     exception-guarded so a transient (a busy box stretching a startup
@@ -2485,7 +2668,7 @@ def run_bench() -> Dict[str, object]:
     env = _env_fingerprint()   # loadavg sampled before any load
     topo = miss = churn = recur = fronted1 = logged = tcp = None
     realistic = degraded = shard = zone_scale = cross_dc = None
-    hostile = verify_ax = None
+    hostile = verify_ax = population = None
     with tempfile.TemporaryDirectory() as tmpdir:
         proc = start_server(tmpdir)
         try:
@@ -2518,6 +2701,10 @@ def run_bench() -> Dict[str, object]:
                                 lambda: _bench_hostile(tmpdir))
             verify_ax = _try_axis("verify",
                                   lambda: _bench_verify(tmpdir))
+        # pure-Python harness: no dnsblast dependency — the population
+        # model's realism is the point, not raw packet rate
+        population = _try_axis("population",
+                               lambda: _bench_population(tmpdir))
         if os.access(DNSBLAST, os.X_OK) and os.access(MBALANCER, os.X_OK):
             topo = _try_axis("topology", lambda: _bench_topology(tmpdir))
             # balancer-overhead isolation (VERDICT r3 item 2): the
@@ -2758,6 +2945,19 @@ def run_bench() -> Dict[str, object]:
         env["hostile_flows"] = hostile["flows"]
         env["hostile_mix"] = hostile["mix"]
         env["hostile_offered_qps"] = HOSTILE_QPS
+    if population is not None:
+        # population axis (ISSUE 19): NAT'd-farm goodput + measured
+        # RRL false-positive rate (adaptive-vs-static interleaved A/B)
+        # + rolling-upgrade probe loss (acceptance: zero)
+        out["population"] = population
+        # env block records the population shape so cross-round
+        # figures are comparable (identities, source prefixes, Zipf
+        # skew, NAT fan-in — the knobs that set RRL pressure)
+        env["population_identities"] = population["identities"]
+        env["population_prefixes"] = population["prefixes"]
+        env["population_zipf_s"] = population["zipf_s"]
+        env["population_nat_fan_in"] = population["nat_fan_in"]
+        env["population_offered_qps"] = POPULATION_QPS_PEAK
     if verify_ax is not None:
         # verify axis (ISSUE 16): mutation→glass per-stage p50/p99 at
         # each zone size (flat = O(delta)), the checker's inline

@@ -20,6 +20,8 @@ DSL — one action per line (``;`` also separates), ``#`` comments::
     at 3.8  tcp-rst conns=2             # torn frame + RST
     at 4.0  expire-session          # loss + immediate re-establish
     at 4.5  shard-kill shard=0      # SIGKILL a serving shard worker
+    at 4.7  worker-roll shard=0     # zero-downtime drain-and-replace
+    at 4.8  rrl-flood n=400         # spoofed-prefix UDP burst
     at 5.0  restore-session         # plain re-establish
     at 5.2  corrupt-answer          # flip a byte in a compiled wire
     at 5.4  drop-reverse            # delete one PTR map entry
@@ -54,6 +56,23 @@ Actions
   acceptance invariant is the supervisor's: the kernel re-hashes the
   dead socket's share to the survivors at once, and the respawned
   worker catches up from snapshot (binder_tpu/shard).
+- ``worker-roll [shard=I]`` — request a zero-downtime drain-and-
+  replace cycle via the driver's ``roll_target`` (the supervisor's
+  ``request_roll``; ``shard`` omitted or -1 rolls every shard in
+  sequence).  Unlike ``shard-kill`` this is the *cooperative* path:
+  the acceptance invariant is zero query loss — replacement converges
+  from snapshot and joins the reuseport group BEFORE the incumbent is
+  drained, one shard at a time.  Rolling mid-incident (after a
+  ``lose-session`` or during an ``rrl-flood``) is exactly the
+  operator reality the chaos smoke pins.
+- ``rrl-flood [n=N] [qname=...]`` — synchronous burst of N (default
+  400) well-formed UDP queries from spoofed attacker-prefix source
+  addresses (the same 127/8 prefixes ``tools/hostile.py`` uses, so
+  per-prefix RRL isolates them from the 127.0.0/24 measurement
+  client), fired at the driver's ``udp_target``.  Replies are never
+  read — the flood models reflection-attack ammunition, and the
+  assertable outcome is on the server: ``binder_rrl_*`` counters move,
+  the legit client's goodput survives.
 - ``corrupt-answer [qname=...]`` / ``drop-reverse [ip=...]`` /
   ``skew-replica [shard=I] [frames=N]`` — verify-plane faults (ISSUE
   16), dispatched by method name at the driver's ``verify_target``
@@ -78,9 +97,13 @@ from typing import Callable, List, Optional, Tuple
 ACTIONS = ("lose-session", "restore-session", "expire-session",
            "watch-storm", "loop-stall", "upstream",
            "tcp-slow-reader", "tcp-half-close", "tcp-rst",
-           "shard-kill",
+           "shard-kill", "worker-roll", "rrl-flood",
            "corrupt-answer", "drop-reverse", "skew-replica")
 STREAM_ACTIONS = ("tcp-slow-reader", "tcp-half-close", "tcp-rst")
+#: spoofed-source /24s the rrl-flood action binds (Linux accepts any
+#: 127/8 address unconfigured) — the SAME prefixes tools/hostile.py
+#: floods from, so one RRL allowlist/bucket story covers both harnesses
+FLOOD_PREFIXES = ("127.66.7", "127.66.8", "127.99.1", "127.99.2")
 #: verify-plane faults, dispatched by method name at ``verify_target``
 VERIFY_ACTIONS = ("corrupt-answer", "drop-reverse", "skew-replica")
 
@@ -193,7 +216,9 @@ class ChaosDriver:
     def __init__(self, plan: FaultPlan, *, store=None,
                  mutate: Optional[Callable[[int], None]] = None,
                  tcp_target: Optional[Tuple[str, int, str]] = None,
+                 udp_target: Optional[Tuple[str, int, str]] = None,
                  shard_target: Optional[Callable[[int], object]] = None,
+                 roll_target: Optional[Callable[[int], object]] = None,
                  verify_target=None,
                  recorder=None,
                  log: Optional[logging.Logger] = None) -> None:
@@ -204,9 +229,16 @@ class ChaosDriver:
         # tcp-* actions with a warning (a plan driven only at the store
         # needs no live listener)
         self.tcp_target = tcp_target
+        # (host, port, qname) the rrl-flood spoofed burst fires at;
+        # falls back to tcp_target (binder serves both lanes on one
+        # port) when unset
+        self.udp_target = udp_target
         # shard-kill sink: the supervisor's kill_shard(index) (index -1
         # = random live worker); None skips with a warning
         self.shard_target = shard_target
+        # worker-roll sink: request_roll(shard) on the supervisor
+        # (shard -1 = roll every shard in sequence)
+        self.roll_target = roll_target
         # verify-plane fault sink: corrupt_answer/drop_reverse on a
         # BinderServer, skew_replica on a shard supervisor — dispatch
         # is by method name, so either (or a test double) fits
@@ -243,6 +275,14 @@ class ChaosDriver:
                                  "target; skipped")
             else:
                 self.shard_target(int(kwargs.get("shard", -1)))
+        elif action == "worker-roll":
+            if self.roll_target is None:
+                self.log.warning("chaos: worker-roll with no roll "
+                                 "target; skipped")
+            else:
+                self.roll_target(int(kwargs.get("shard", -1)))
+        elif action == "rrl-flood":
+            self._flood_action(kwargs)
         elif action in STREAM_ACTIONS:
             self._stream_action(action, kwargs)
         elif action in VERIFY_ACTIONS:
@@ -295,6 +335,52 @@ class ChaosDriver:
             # loud, so a smoke that asserted a detection can tell
             # "not injected" apart from "not detected"
             self.log.warning("chaos: %s found no target state", action)
+
+    def _flood_action(self, kwargs: dict) -> None:
+        """Spoofed-prefix UDP burst: n queries round-robined across
+        sockets bound inside the attacker /24s, replies never read.
+        Synchronous and send-only — a few hundred sendto()s finish in
+        single-digit milliseconds, well inside timeline accuracy."""
+        target = self.udp_target or self.tcp_target
+        if target is None:
+            self.log.warning("chaos: rrl-flood with no udp target; "
+                             "skipped")
+            return
+        host, port, default_qname = target
+        n = int(kwargs.get("n", 400))
+        qname = str(kwargs.get("qname", default_qname))
+        from binder_tpu.dns.wire import Type, make_query
+        import socket as socket_mod
+        socks = []
+        for pfx in FLOOD_PREFIXES:
+            for host_octet in (7, 8):
+                s = socket_mod.socket(socket_mod.AF_INET,
+                                      socket_mod.SOCK_DGRAM)
+                try:
+                    s.bind((f"{pfx}.{host_octet}", 0))
+                    s.connect((host, port))
+                    s.setblocking(False)
+                except OSError:
+                    s.close()
+                    continue
+                socks.append(s)
+        if not socks:
+            self.log.warning("chaos: rrl-flood could not bind any "
+                             "spoofed source; skipped")
+            return
+        try:
+            for i in range(n):
+                wire = make_query(qname, Type.A,
+                                  qid=(i % 65535) + 1).encode()
+                try:
+                    socks[i % len(socks)].send(wire)
+                except OSError:
+                    # full socket buffer / ICMP-refused connect errors
+                    # are flood reality, not harness failures
+                    pass
+        finally:
+            for s in socks:
+                s.close()
 
     def _stream_action(self, action: str, kwargs: dict) -> None:
         if self.tcp_target is None:

@@ -803,6 +803,14 @@ class DnsServer:
                         except OSError:
                             pass
                 return
+        elif rrl is not None and protocol == "tcp":
+            # adaptive-bucket liveness evidence: a TCP query reaching
+            # the serve path at all proves a completed handshake — the
+            # one thing a spoofed source can never do.  While the
+            # limiter is hot the fastpath gate is shut, so exactly the
+            # TCP retries that matter (slipped clients coming back)
+            # surface here.
+            rrl.note_tcp(src[0])
         # Native answer-cache/zone serve for the lanes that have no C
         # drain of their own — TCP and the balancer socket.  Direct-UDP
         # packets reaching here already missed inside fastpath_drain,
@@ -1339,6 +1347,70 @@ class DnsServer:
             link.send_frame(frame)
 
     # -- lifecycle --
+
+    async def quiesce(self, timeout: float = 5.0) -> int:
+        """Graceful stop-accepting for the rolling drain-and-replace
+        cycle (shard supervisor, docs/operations.md "Rolling
+        upgrade"): stop taking NEW work, serve out what is already
+        here, then leave the ``SO_REUSEPORT`` group.
+
+        Order matters: the accept paths close first (new TCP clients
+        re-hash to the surviving group members immediately), then the
+        UDP read loop stops and the datagrams the kernel already
+        queued to this socket — which would be silently dropped at
+        close — are served out synchronously before the socket closes
+        and its hash share moves over.  Finally a bounded wait lets
+        async in-flight queries finish and one settle tick lets the
+        stream lane's write coalescing flush.  Returns the number of
+        in-flight queries still pending at the deadline (0 == clean
+        drain)."""
+        for loop, lsock in self._tcp_listeners:
+            try:
+                loop.remove_reader(lsock.fileno())
+            except (OSError, ValueError):
+                pass
+            lsock.close()
+        self._tcp_listeners.clear()
+        for loop, lsock, _path in self._unix_servers:
+            try:
+                loop.remove_reader(lsock.fileno())
+            except (OSError, ValueError):
+                pass
+            lsock.close()
+        self._unix_servers.clear()
+        for loop, sock in self._udp_socks:
+            try:
+                loop.remove_reader(sock.fileno())
+            except (OSError, ValueError):
+                pass
+            while True:
+                try:
+                    data, addr = sock.recvfrom(65535)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    break
+
+                def send(wire: bytes, _sock=sock, _addr=addr) -> None:
+                    try:
+                        _sock.sendto(wire, _addr)
+                    except OSError:
+                        pass
+
+                self._handle_raw(data, (addr[0], addr[1]), "udp", send)
+            # leaving the group NOW keeps the unread window to the
+            # microseconds between the drain loop and this close; an
+            # async in-flight UDP answer past this point is best-effort
+            # (its reply socket is gone), matching the sync-dominated
+            # shard serving profile
+            sock.close()
+        self._udp_socks.clear()
+        deadline = time.monotonic() + timeout
+        while self.inflight and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        # one settle pass for the per-tick TCP write coalescing
+        await asyncio.sleep(0.05)
+        return len(self.inflight)
 
     async def close(self) -> None:
         for loop, sock in self._udp_socks:

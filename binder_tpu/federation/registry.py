@@ -36,10 +36,12 @@ class DcRegistry:
     Works against any :class:`~binder_tpu.store.interface.StoreClient`:
     delivery is purely push-based (children watcher on ``/dcs``, data
     watcher per child), so the fake store's synchronous events and real
-    ZooKeeper's async ones both land here.  ``static_records`` seeds the
-    map for stores whose event feed does not carry ``/dcs`` (shard
-    ``ReplicaStore`` workers: the supervisor's mutation log fans out the
-    dnsDomain tree only).
+    ZooKeeper's async ones both land here — including shard
+    ``ReplicaStore`` workers, whose ``/dcs`` subtree is fanned through
+    the supervisor's mutation log (``pnode``/``pgone`` frames) so a
+    worker sees a DC join or leave exactly like the owner does.
+    ``static_records`` seeds the map for config-pinned membership
+    (deployments whose store carries no ``/dcs`` at all).
     """
 
     def __init__(self, store, *, self_name: str, path: str = DCS_PATH,

@@ -137,6 +137,17 @@ async def run_supervisor(options: Dict[str, object]):
 
     loop.add_signal_handler(signal.SIGTERM, on_sigterm)
 
+    def on_sighup():
+        # zero-downtime rolling operations (docs/operations.md
+        # "Rolling upgrade / config reload"): re-read the config file
+        # and drain-and-replace one shard at a time; a roll already in
+        # progress absorbs the repeat signal
+        log.info("caught SIGHUP; rolling %d shard(s) with reloaded "
+                 "config", supervisor.n)
+        supervisor.request_roll(reload_config=True)
+
+    loop.add_signal_handler(signal.SIGHUP, on_sighup)
+
     # chaos (supervisor-side): store faults and watch storms hit the
     # owner mirror and propagate down every mutation log; shard-kill
     # SIGKILLs a worker mid-load; stream faults drive the shared
@@ -163,7 +174,13 @@ async def run_supervisor(options: Dict[str, object]):
             mutate=chaos_mutate if hasattr(store, "put_json") else None,
             tcp_target=(chaos_host, supervisor.tcp_port,
                         f"chaos0.{domain}"),
+            udp_target=(chaos_host, supervisor.udp_port,
+                        f"chaos0.{domain}"),
             shard_target=supervisor.kill_shard,
+            # worker-roll is the cooperative counterpart to shard-kill:
+            # drain-and-replace with zero query loss, mid-incident
+            roll_target=lambda shard=-1: supervisor.request_roll(
+                shard=shard),
             # skew-replica desyncs one worker's mutation log (the
             # digest frames must catch it); the supervisor owns the
             # per-link streams
@@ -404,6 +421,8 @@ async def run(options: Dict[str, object]) -> BinderServer:
             # tcp-rst) drive the server's own TCP listener
             tcp_target=(chaos_host, server.tcp_port,
                         f"chaos0.{domain}"),
+            udp_target=(chaos_host, server.udp_port,
+                        f"chaos0.{domain}"),
             # verify-plane corruption (corrupt-answer / drop-reverse)
             # mutates the server's own tables behind the checker's back
             verify_target=server,
@@ -494,6 +513,21 @@ def _wire_shard_worker(server: BinderServer, store, metrics, collector,
         log.info("shard %d: caught SIGTERM; draining", shard)
 
         async def _drain():
+            # rolling-drain semantics (docs/operations.md "Rolling
+            # upgrade"): leave the reuseport group and serve out the
+            # in-flight queries BEFORE tearing the serve stack down —
+            # stop() cancels whatever quiesce could not finish
+            try:
+                pending = await server.engine.quiesce()
+                if pending:
+                    log.warning("shard %d: %d in-flight quer(ies) "
+                                "unfinished at the drain deadline",
+                                shard, pending)
+                else:
+                    log.info("shard %d: quiesced clean (in-flight "
+                             "served out)", shard)
+            except Exception:
+                log.exception("shard %d: quiesce failed", shard)
             await server.stop()
             metrics.stop()
             os._exit(0)

@@ -48,12 +48,34 @@ Mechanics, mirroring `AdmissionControl`'s house style:
 
 The limiter judges the packet *before* decode on the UDP lane, so
 malformed floods are shed at the same price as well-formed ones.
+
+v2 adds the two production escape hatches the base mechanism lacks
+(docs/operations.md "Binder is under attack"):
+
+- **Allowlists** — config-driven source prefixes that are never
+  limited.  Judged inside `decide()` (pre-decode, raw-bytes cost) via
+  a per-full-IP verdict cache, so an allowlisted monitoring host or
+  anycast peer pays one prefix match ever; allowlisted sources never
+  mint buckets, so they cannot be evicted into limiting by a spray.
+- **Adaptive buckets** — the NAT'd-resolver-farm fix.  A /24 hiding
+  thousands of real clients overdraws its bucket at aggregate qps and
+  every one of those drops is a false positive.  But the TC=1 slip is
+  a built-in liveness probe: a *real* client retries the slipped query
+  over TCP (spoofed floods never complete a handshake).  The stream
+  lane reports completed TCP serves via `note_tcp()`; a prefix that
+  keeps completing TCP retries *while being limited* accumulates
+  evidence and earns a doubled rate multiplier (up to
+  ``adaptMaxMultiplier``), converging on just enough headroom that
+  limiting stops.  Limited responses charged to a prefix before it
+  proved real are attributed to ``false_positives`` — making the RRL
+  false-positive rate a measured number, not a guess.
 """
 from __future__ import annotations
 
 import logging
+import socket
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 DEFAULT_RESPONSES_PER_SECOND = 200.0
 DEFAULT_BURST = 400.0
@@ -62,6 +84,10 @@ DEFAULT_PREFIX_V4 = 24
 DEFAULT_PREFIX_V6 = 56
 #: prefixes tracked at once (LRU) — bounds memory under spoofing
 DEFAULT_MAX_BUCKETS = 8192
+#: adaptive sizing: rate-multiplier ceiling a TCP-proven prefix can earn
+DEFAULT_ADAPT_MAX_MULTIPLIER = 16.0
+#: completed TCP serves (while limited) per doubling step
+DEFAULT_ADAPT_EVIDENCE = 3
 
 #: decide() verdicts
 SEND, SLIP, DROP = 0, 1, 2
@@ -86,6 +112,10 @@ class ResponseRateLimiter:
     #: events surfaces to Python so the limiter samples the C-served
     #: stream (each sampled packet charged this many tokens)
     FASTPATH_SAMPLE_EVERY = 8
+    #: adapted-prefix records tracked at once — entries exist only for
+    #: prefixes that completed a TCP serve while limited, so spoofed
+    #: floods (which never complete a handshake) cannot mint them
+    ADAPT_MAX_TRACKED = 1024
 
     def __init__(self, *, enabled: bool = True,
                  responses_per_second: float = DEFAULT_RESPONSES_PER_SECOND,
@@ -94,6 +124,10 @@ class ResponseRateLimiter:
                  prefix_v4: int = DEFAULT_PREFIX_V4,
                  prefix_v6: int = DEFAULT_PREFIX_V6,
                  max_buckets: int = DEFAULT_MAX_BUCKETS,
+                 allowlist: Sequence[str] = (),
+                 adaptive: bool = True,
+                 adapt_max_multiplier: float = DEFAULT_ADAPT_MAX_MULTIPLIER,
+                 adapt_evidence: int = DEFAULT_ADAPT_EVIDENCE,
                  note_shed: Optional[Callable] = None,
                  recorder=None,
                  log: Optional[logging.Logger] = None) -> None:
@@ -104,6 +138,9 @@ class ResponseRateLimiter:
         self.prefix_v4 = int(prefix_v4)
         self.prefix_v6 = int(prefix_v6)
         self.max_buckets = int(max_buckets)
+        self.adaptive = bool(adaptive)
+        self.adapt_max_multiplier = float(adapt_max_multiplier)
+        self.adapt_evidence = max(1, int(adapt_evidence))
         self.note_shed = note_shed     # AdmissionControl._note_shed
         self.recorder = recorder
         self.log = log or logging.getLogger("binder.rrl")
@@ -113,6 +150,24 @@ class ResponseRateLimiter:
         # full source ip -> prefix string; computing a v6 prefix per
         # packet would be the flood's cost, not the flooder's
         self._prefix_cache: Dict[str, str] = {}
+        # allowlist: (packed_network, nbytes, tailmask) per family;
+        # per-full-IP verdicts cached so the match runs once per source
+        self.allowlist: Tuple[str, ...] = tuple(allowlist or ())
+        self._allow_nets_v4: List[Tuple[bytes, int, int]] = []
+        self._allow_nets_v6: List[Tuple[bytes, int, int]] = []
+        for entry in self.allowlist:
+            parsed = self._parse_network(entry)
+            if parsed is None:
+                self.log.warning("rrl: ignoring bad allowlist entry %r",
+                                 entry)
+                continue
+            (self._allow_nets_v6 if parsed[3] else
+             self._allow_nets_v4).append(parsed[:3])
+        self._allow_cache: Dict[str, bool] = {}
+        # adaptive sizing: prefix -> [multiplier, evidence, limited_cum]
+        # — separate from the bucket LRU so a spray that evicts the
+        # bucket cannot erase an earned multiplier
+        self._adapted: Dict[str, List] = {}
         self._hot_until = 0.0
         self._flood_event_last = 0.0
         #: tokens one decide() charges; the batched UDP reader raises
@@ -124,6 +179,11 @@ class ResponseRateLimiter:
         self.slipped = 0
         self.dropped = 0
         self.evictions = 0
+        self.allowlisted = 0   # responses passed by allowlist match
+        self.adaptations = 0   # multiplier doubling steps taken
+        #: limited responses charged to a prefix *before* it proved
+        #: real via TCP completion — the measured false-positive count
+        self.false_positives = 0
 
     @classmethod
     def from_config(cls, config: Optional[dict], *,
@@ -143,7 +203,68 @@ class ResponseRateLimiter:
             prefix_v4=config.get("prefixV4", DEFAULT_PREFIX_V4),
             prefix_v6=config.get("prefixV6", DEFAULT_PREFIX_V6),
             max_buckets=config.get("maxBuckets", DEFAULT_MAX_BUCKETS),
+            allowlist=config.get("allowlist", ()),
+            adaptive=config.get("adaptive", True),
+            adapt_max_multiplier=config.get(
+                "adaptMaxMultiplier", DEFAULT_ADAPT_MAX_MULTIPLIER),
+            adapt_evidence=config.get(
+                "adaptEvidence", DEFAULT_ADAPT_EVIDENCE),
             note_shed=note_shed, recorder=recorder, log=log)
+
+    # -- allowlist --
+
+    @staticmethod
+    def _parse_network(entry: str) -> Optional[Tuple[bytes, int, int, bool]]:
+        """``"10.0.0.0/8"`` → (packed_network, whole_bytes, tail_mask,
+        is_v6); a bare address gets the full-length prefix.  None on
+        garbage — config typos must not crash the serve stack."""
+        try:
+            text, _, bits_s = str(entry).partition("/")
+            v6 = ":" in text
+            fam = socket.AF_INET6 if v6 else socket.AF_INET
+            raw = socket.inet_pton(fam, text.strip())
+            width = len(raw) * 8
+            bits = int(bits_s) if bits_s else width
+            if not 0 <= bits <= width:
+                return None
+        except (OSError, ValueError):
+            return None
+        nbytes, rem = divmod(bits, 8)
+        tail_mask = (0xFF00 >> rem) & 0xFF if rem else 0
+        network = raw[:nbytes + (1 if rem else 0)]
+        if rem:
+            network = network[:-1] + bytes([network[-1] & tail_mask])
+        return (network, nbytes, tail_mask, v6)
+
+    def _allowed(self, ip: str) -> bool:
+        """Pre-decode allowlist check: one inet_pton + linear match per
+        *new* source IP, a dict hit thereafter.  The verdict cache is
+        bounded like every other table here."""
+        cached = self._allow_cache.get(ip)
+        if cached is not None:
+            return cached
+        v6 = ":" in ip
+        nets = self._allow_nets_v6 if v6 else self._allow_nets_v4
+        verdict = False
+        if nets:
+            try:
+                raw = socket.inet_pton(
+                    socket.AF_INET6 if v6 else socket.AF_INET, ip)
+            except OSError:
+                raw = None
+            if raw is not None:
+                for network, nbytes, tail_mask in nets:
+                    if raw[:nbytes] != network[:nbytes]:
+                        continue
+                    if tail_mask and (raw[nbytes] & tail_mask
+                                      != network[nbytes]):
+                        continue
+                    verdict = True
+                    break
+        if len(self._allow_cache) >= self.max_buckets:
+            self._allow_cache.pop(next(iter(self._allow_cache)))
+        self._allow_cache[ip] = verdict
+        return verdict
 
     # -- prefix mapping --
 
@@ -184,18 +305,30 @@ class ResponseRateLimiter:
         are handled here; the caller only routes the verdict."""
         if not self.enabled:
             return SEND
+        if ((self._allow_nets_v4 or self._allow_nets_v6)
+                and self._allowed(ip)):
+            # never limited, never minting a bucket slot — the spray
+            # cannot evict an allowlisted peer into limiting
+            self.allowlisted += 1
+            return SEND
         now = time.monotonic()
         prefix = self._prefix(ip)
+        # TCP-proven prefixes run with an earned rate multiplier; the
+        # dict is empty until the first note_tcp() adaptation, so the
+        # common path pays one truthiness check
+        adapted = self._adapted.get(prefix) if self._adapted else None
+        mult = adapted[0] if adapted is not None else 1.0
+        burst = self.burst * mult
         entry = self._buckets.pop(prefix, None)
         if entry is None:
             if len(self._buckets) >= self.max_buckets:
                 self._buckets.pop(next(iter(self._buckets)))
                 self.evictions += 1
-            tokens, limited = self.burst, 0
+            tokens, limited = burst, 0
         else:
             tokens, last, limited = entry
-            tokens = min(self.burst,
-                         tokens + (now - last) * self.responses_per_second)
+            tokens = min(burst, tokens + (now - last)
+                         * self.responses_per_second * mult)
         if tokens >= 1.0:
             self._buckets[prefix] = (tokens - self.sample_cost, now, 0)
             self.responses += 1
@@ -203,6 +336,10 @@ class ResponseRateLimiter:
         # limited: slip every slip_ratio-th, drop the rest
         limited += 1
         self._buckets[prefix] = (tokens, now, limited)
+        if adapted is not None:
+            # candidate false positive: this prefix has completed TCP
+            # serves before; attributed when the next adaptation lands
+            adapted[2] += 1
         self._hot_until = now + self.HOT_HOLD_S
         if (self.recorder is not None
                 and now - self._flood_event_last
@@ -219,6 +356,61 @@ class ResponseRateLimiter:
         if self.note_shed is not None:
             self.note_shed("response-ratelimit", prefix=prefix)
         return DROP
+
+    # -- adaptive sizing (TCP liveness evidence) --
+
+    def note_tcp(self, ip: str) -> None:
+        """A TCP query from *ip* was served to completion.
+
+        Called by the stream lane after a successful TCP serve.  While
+        a prefix is being limited, each completed TCP serve is proof a
+        real client sits behind it — a spoofed source cannot finish the
+        handshake the TC=1 slip invites.  ``adapt_evidence`` proofs buy
+        one doubling of the prefix's rate multiplier (capped at
+        ``adapt_max_multiplier``), and the limited responses the prefix
+        absorbed before each doubling are attributed to
+        ``false_positives``.  Off the limited path this is one dict
+        lookup; evidence only accrues while the prefix's bucket shows
+        active limiting, so adapted farms stop growing once they have
+        just enough headroom."""
+        if not self.enabled or not self.adaptive:
+            return
+        prefix = self._prefix(ip)
+        adapted = self._adapted.get(prefix)
+        bucket = self._buckets.get(prefix)
+        limiting = bucket is not None and (bucket[0] < 1.0 or bucket[2] > 0)
+        if adapted is None:
+            if not limiting:
+                return      # ordinary TCP traffic, nothing to prove
+            if len(self._adapted) >= self.ADAPT_MAX_TRACKED:
+                self._adapted.pop(next(iter(self._adapted)))
+            # seed the false-positive ledger with the limited streak
+            # that pushed this client to TCP in the first place
+            adapted = self._adapted[prefix] = [1.0, 0, bucket[2]]
+        elif not limiting:
+            return
+        adapted[1] += 1
+        if (adapted[1] < self.adapt_evidence
+                or adapted[0] >= self.adapt_max_multiplier):
+            return
+        adapted[1] = 0
+        adapted[0] = min(self.adapt_max_multiplier, adapted[0] * 2.0)
+        self.adaptations += 1
+        self.false_positives += adapted[2]
+        fp = adapted[2]
+        adapted[2] = 0
+        if self.recorder is not None:
+            self.recorder.record(
+                "rrl-adapt", prefix=prefix, multiplier=adapted[0],
+                false_positives=fp)
+        self.log.info("rrl: adapted %s to %.0fx (%d limited responses "
+                      "attributed as false positives)",
+                      prefix, adapted[0], fp)
+
+    def adapted_count(self) -> int:
+        """Prefixes currently holding an earned multiplier > 1 — the
+        ``binder_rrl_adapted_buckets`` gauge."""
+        return sum(1 for v in self._adapted.values() if v[0] > 1.0)
 
     @staticmethod
     def slip_reply(data: bytes) -> Optional[bytes]:
@@ -262,4 +454,10 @@ class ResponseRateLimiter:
             "slipped": self.slipped,
             "dropped": self.dropped,
             "evictions": self.evictions,
+            "allowlist": list(self.allowlist),
+            "allowlisted": self.allowlisted,
+            "adaptive": self.adaptive,
+            "adapted_buckets": self.adapted_count(),
+            "adaptations": self.adaptations,
+            "false_positives": self.false_positives,
         }

@@ -307,6 +307,13 @@ class BinderServer:
                 ("dropped", "rate-limited UDP queries dropped silently"),
                 ("evictions", "RRL prefix buckets evicted at the LRU "
                  "cap"),
+                ("allowlisted", "responses passed by an RRL allowlist "
+                 "match (never limited, never bucketed)"),
+                ("adaptations", "adaptive-bucket rate doublings earned "
+                 "by TCP-proven prefixes"),
+                ("false_positives", "rate-limited responses charged to "
+                 "a prefix later proven real by completed TCP retries "
+                 "(the measured RRL false-positive count)"),
             ):
                 child = self.collector.counter(
                     "binder_rrl_" + field + "_total", help_text).labelled()
@@ -323,6 +330,11 @@ class BinderServer:
                 "(the hostile-flood posture; also closes the native "
                 "fastpath gate)"
             ).set_function(lambda: 1.0 if self._rrl.hot() else 0.0)
+            self.collector.gauge(
+                "binder_rrl_adapted_buckets",
+                "client prefixes holding an earned adaptive rate "
+                "multiplier (TCP-proven NAT'd farms)"
+            ).set_function(lambda: float(self._rrl.adapted_count()))
         if recursion is not None and hasattr(recursion, "engine_after"):
             # arm the recursion fast path: its future callback completes
             # the query AND runs the engine's after hook itself

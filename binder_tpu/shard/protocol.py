@@ -86,6 +86,25 @@ def gone_frame(domain: str, tr: Optional[str] = None,
     return f
 
 
+def path_node_frame(path: str, data) -> dict:
+    """Upsert one RAW-PATH node (federation ``/dcs`` fanout, ROADMAP
+    3a): unlike ``node`` frames — which are keyed by lookup domain
+    under the served zone — these carry subtrees OUTSIDE the zone that
+    workers must still track live (DC join/leave).  Applying one at
+    the replica fires the same FakeStore watcher events a local store
+    mutation would, so the worker's own ``DcRegistry`` sees membership
+    changes with zero registry-side changes.  Deliberately NOT part of
+    the replica-parity digest: the digest pins zone-data parity, and
+    older peers warn-and-ignore the unknown op."""
+    return {"op": "pnode", "p": path, "data": data}
+
+
+def path_gone_frame(path: str) -> dict:
+    """Remove one raw-path node (and its subtree) — the ``pnode``
+    counterpart for DC leave."""
+    return {"op": "pgone", "p": path}
+
+
 def state_frame(state: str, connected: bool,
                 disconnected_s: Optional[float],
                 establishments: int) -> dict:

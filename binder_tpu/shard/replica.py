@@ -205,6 +205,14 @@ class ReplicaStore(FakeStore):
             if tracer is not None:
                 tracer.observe("replica-apply")
                 tracer.clear()
+        elif op == "pnode":
+            # raw-path upsert (federation /dcs fanout): applied at the
+            # literal path so the worker's DcRegistry watchers fire
+            # exactly as they would against a live store.  Outside the
+            # replica-parity digest by design (zone data only).
+            self._apply_path(str(frame["p"]), frame.get("data"))
+        elif op == "pgone":
+            self.rmr(str(frame["p"]))
         elif op == "state":
             self._apply_state(frame)
         elif op == "digest":
@@ -242,7 +250,9 @@ class ReplicaStore(FakeStore):
                 self.log.exception("on_digest callback failed")
 
     def _apply_node(self, domain: str, data) -> None:
-        path = domain_to_path(domain)
+        self._apply_path(domain_to_path(domain), data)
+
+    def _apply_path(self, path: str, data) -> None:
         raw = b"" if data is None else json.dumps(data).encode("utf-8")
         if self.exists(path):
             self.set_data(path, raw)

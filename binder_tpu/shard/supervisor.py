@@ -26,6 +26,18 @@ aggregates ``/status`` + Prometheus metrics across shards (the
 ``binder_shard_*`` family, one ``shard`` label per series; each
 worker's own metrics endpoint stays reachable for drill-down — its
 port is in the supervisor snapshot).
+
+Zero-downtime rolling operations (SIGHUP / ``roll_all``,
+docs/operations.md "Rolling upgrade / config reload"): one shard at a
+time, spawn the replacement worker, stream it the attach snapshot,
+wait for it to converge (hello + replica ready) and join the
+``SO_REUSEPORT`` group — at which point the kernel already splits
+load across old AND new — then SIGTERM the old incarnation, which
+quiesces (stops accepting, serves out in-flight) and exits.  A
+replacement that fails to converge aborts the roll with the old
+worker still serving; no client ever sees an empty group.  Config
+reload rides the same cycle: the config file is re-read once up
+front and each replacement spawns with the fresh config.
 """
 from __future__ import annotations
 
@@ -59,6 +71,14 @@ RESPAWN_BACKOFF_MAX_S = 5.0
 #: log this far behind is wedged — kill it and let snapshot catch-up
 #: do its job (bounded memory beats an unbounded replay queue)
 MAX_LINK_BUFFER = 256 << 20
+
+#: rolling upgrade: a replacement worker must hello AND report a
+#: ready replica within this window, else the step aborts with the
+#: old worker still serving
+ROLL_CONVERGE_S = 30.0
+#: bounded graceful-drain window for the outgoing incarnation (it
+#: quiesces and exits on SIGTERM; stragglers are KILLed)
+ROLL_DRAIN_S = 10.0
 
 SUPERVISOR_SNAPSHOT_VERSION = 1
 
@@ -127,6 +147,14 @@ class ShardSupervisor:
         self.udp_port: Optional[int] = self.port or None
         self.tcp_port: Optional[int] = None
         self.links: Dict[int, ShardLink] = {}
+        # rolling upgrade state: replacement links catching up while
+        # the incumbent still serves (shard -> ShardLink), the roll
+        # counters, and the single-roll-at-a-time guard
+        self._roll_links: Dict[int, ShardLink] = {}
+        self.rolls: Dict[int, int] = {i: 0 for i in range(self.n)}
+        self.roll_aborts = 0
+        self._rolling_shard: Optional[int] = None
+        self._roll_busy = False
         self.respawns: Dict[int, int] = {i: 0 for i in range(self.n)}
         self._consec_fail: Dict[int, int] = {i: 0 for i in range(self.n)}
         self._respawn_at: Dict[int, float] = {}
@@ -162,6 +190,22 @@ class ShardSupervisor:
         # the owner mirror's per-name invalidation events ARE the
         # mutation log: every tag maps to a node upsert or removal
         cache.on_invalidate(self._on_invalidate)
+        # federation membership rides the same log (ROADMAP 3a): the
+        # owner watches /dcs exactly like DcRegistry does and fans
+        # join/leave through as raw-path frames, so shard workers track
+        # membership LIVE instead of bootstrapping from static config
+        fed = options.get("federation") or {}
+        self._dcs_path = "/" + str(
+            fed.get("dcsPath", "/dcs")).strip("/")
+        self._dcs_records: Dict[str, object] = {}
+        self._dcs_watched: set = set()
+        try:
+            store.watcher(self._dcs_path).on(
+                "children", self._on_dcs_children)
+            store.on_session(self._resync_dcs)
+        except Exception:
+            self.log.debug("store has no watcher surface; "
+                           "/dcs fanout off")
 
     # -- metrics: the binder_shard_* family (docs/observability.md) --
 
@@ -193,8 +237,19 @@ class ShardSupervisor:
                          "queries shed by admission control per shard "
                          "(all reasons, folded monotonically across "
                          "respawns)")
+        rolls = c.counter("binder_shard_rolls_total",
+                          "completed zero-downtime drain-and-replace "
+                          "cycles per shard (rolling upgrade / config "
+                          "reload)")
+        self._m_roll_aborts = c.counter(
+            "binder_shard_roll_aborts_total",
+            "rolling-upgrade steps aborted because the replacement "
+            "failed to converge (the old worker kept serving)"
+        ).labelled()
+        self._m_roll_aborts.inc(0)
         self._rrl_drop_children = {}
         self._shed_children = {}
+        self._roll_children = {}
         for i in range(self.n):
             labels = {"shard": str(i)}
             up.set_function(lambda i=i: self._up(i), labels)
@@ -215,6 +270,9 @@ class ShardSupervisor:
             sc = shed.labelled(labels)
             sc.inc(0)
             self._shed_children[i] = sc
+            rlc = rolls.labelled(labels)
+            rlc.inc(0)
+            self._roll_children[i] = rlc
 
     def _up(self, i: int) -> float:
         link = self.links.get(i)
@@ -265,8 +323,9 @@ class ShardSupervisor:
         self.log.info("TCP DNS service started on %s:%d", self.host,
                       self.tcp_port)
 
-    async def _wait_hello(self, i: int, timeout: float = 30.0) -> dict:
-        link = self.links[i]
+    async def _wait_hello(self, i: int, timeout: float = 30.0,
+                          link: Optional[ShardLink] = None) -> dict:
+        link = self.links[i] if link is None else link
         if link.hello is not None:
             return link.hello
         fut = self._loop.create_future()
@@ -296,6 +355,13 @@ class ShardSupervisor:
         return path
 
     def _spawn(self, i: int, port: int) -> None:
+        self.links[i] = self._spawn_link(i, port)
+
+    def _spawn_link(self, i: int, port: int,
+                    role: str = "serving") -> ShardLink:
+        """Create one worker incarnation WITHOUT installing it as the
+        shard's serving link — the rolling upgrade spawns replacements
+        that catch up next to the incumbent before promotion."""
         parent, child = socket.socketpair(socket.AF_UNIX,
                                           socket.SOCK_STREAM)
         argv = [sys.executable, "-u", "-m", "binder_tpu.main",
@@ -313,16 +379,71 @@ class ShardSupervisor:
             child.close()
         parent.setblocking(False)
         link = ShardLink(i, proc, parent)
-        self.links[i] = link
         self._loop.add_reader(parent.fileno(), self._on_worker_readable,
                               link)
         # attach-time snapshot: the worker replays this, then the
         # delta feed continues seamlessly on the same ordered stream
         self._send_snapshot(link)
-        self.log.info("shard %d spawned (pid %d)", i, proc.pid)
+        self.log.info("shard %d %s spawned (pid %d)", i, role, proc.pid)
         if self.recorder is not None:
             self.recorder.record("shard-spawn", shard=i, pid=proc.pid,
-                                 respawns=self.respawns[i])
+                                 respawns=self.respawns[i], role=role)
+        return link
+
+    # -- federation /dcs fanout (ROADMAP 3a) --
+
+    def _resync_dcs(self) -> None:
+        """Session (re-)establishment: pull current /dcs state when
+        the store reads synchronously (FakeStore family); real
+        ZooKeeper re-delivers through the re-registered watches."""
+        import inspect
+        get_children = getattr(self.store, "get_children", None)
+        get_data = getattr(self.store, "get_data", None)
+        if (not callable(get_children) or not callable(get_data)
+                or inspect.iscoroutinefunction(get_children)):
+            return
+        kids = get_children(self._dcs_path)
+        if kids is None:
+            return
+        self._on_dcs_children(kids)
+        for k in kids:
+            data = get_data(self._dcs_path + "/" + k)
+            if data is not None:
+                self._on_dcs_data(k, data)
+
+    def _on_dcs_children(self, kids) -> None:
+        names = set(kids or [])
+        for k in sorted(names - self._dcs_watched):
+            self._dcs_watched.add(k)
+            # the data watcher delivers the child's current record
+            # synchronously on attach (fake store) — dc data flows
+            # from _on_dcs_data either way
+            self.store.watcher(self._dcs_path + "/" + k).on(
+                "data", lambda data, _k=k: self._on_dcs_data(_k, data))
+        for k in sorted(self._dcs_watched - names):
+            self._dcs_watched.discard(k)
+            if k in self._dcs_records:
+                del self._dcs_records[k]
+                self._dcs_fanout(protocol.path_gone_frame(
+                    self._dcs_path + "/" + k))
+
+    def _on_dcs_data(self, dc: str, data) -> None:
+        try:
+            obj = (json.loads(bytes(data).decode("utf-8"))
+                   if data else None)
+        except (ValueError, UnicodeDecodeError):
+            obj = None
+        if self._dcs_records.get(dc) == obj and dc in self._dcs_records:
+            return
+        self._dcs_records[dc] = obj
+        self._dcs_fanout(protocol.path_node_frame(
+            self._dcs_path + "/" + dc, obj))
+
+    def _dcs_fanout(self, frame: dict) -> None:
+        # _send, NOT _send_delta: raw-path frames stay outside the
+        # replica-parity digest (it pins zone data only)
+        for link in self._fanout_links():
+            self._send(link, frame)
 
     # -- mutation-log fanout --
 
@@ -364,6 +485,11 @@ class ShardSupervisor:
         state, so replaying them in any interleaving converges the
         worker to the owner's view."""
         self._send(link, self._state_frame())
+        # current federation membership first (ROADMAP 3a): the
+        # worker's DcRegistry is live from the instant it attaches
+        for dc in sorted(self._dcs_records):
+            self._send(link, protocol.path_node_frame(
+                self._dcs_path + "/" + dc, self._dcs_records[dc]))
         link.snap_queue = deque()
         link.snap_sent = 0
         link.snap_started = time.monotonic()
@@ -411,7 +537,7 @@ class ShardSupervisor:
         domains and PTR qnames; only forward names under the served
         domain map to mirrored nodes (workers rebuild their own
         reverse index from node data)."""
-        if not self.links:
+        if not self.links and not self._roll_links:
             return
         domain = self.cache.domain
         suffix = "." + domain
@@ -431,7 +557,7 @@ class ShardSupervisor:
         if not frames:
             return
         gen = self.cache.gen
-        for link in list(self.links.values()):
+        for link in self._fanout_links():
             for frame in frames:
                 self._send_delta(link, frame)
             # one digest frame per delta batch: the replica compares
@@ -478,6 +604,27 @@ class ShardSupervisor:
         link.skew_pending += max(1, int(frames))
         return link.shard
 
+    def _fanout_links(self) -> List[ShardLink]:
+        """Every link the mutation log must reach: the serving set
+        plus replacements catching up mid-roll (a replacement that
+        missed deltas between its snapshot and promotion would serve
+        an aging mirror the moment it binds the reuseport group)."""
+        links = list(self.links.values())
+        if self._roll_links:
+            links.extend(self._roll_links.values())
+        return links
+
+    def _kill_link(self, link: ShardLink) -> None:
+        """Link-scoped wedge recovery: sever the stream and SIGKILL
+        THIS incarnation (``kill_shard`` is index-keyed and would hit
+        the serving link — wrong answer for a mid-roll replacement)."""
+        self._close_link(link)
+        if link.proc.poll() is None:
+            try:
+                link.proc.kill()
+            except (ProcessLookupError, OSError):
+                pass
+
     def _send(self, link: ShardLink, frame: dict) -> None:
         if link.closed:
             return
@@ -488,7 +635,7 @@ class ShardSupervisor:
             self.log.error("shard %d: mutation log %d bytes behind; "
                            "killing for respawn", link.shard,
                            len(link.wbuf))
-            self.kill_shard(link.shard)
+            self._kill_link(link)
             return
         self._flush(link)
 
@@ -541,7 +688,7 @@ class ShardSupervisor:
         except ValueError:
             self.log.error("shard %d: corrupt worker stream; killing",
                            link.shard)
-            self.kill_shard(link.shard)
+            self._kill_link(link)
             return
         for frame in frames:
             op = frame.get("op")
@@ -650,7 +797,7 @@ class ShardSupervisor:
         # degradation policies age on the owner's measured clock
         state = self._state_tuple()
         frame = protocol.state_frame(*state)
-        for link in list(self.links.values()):
+        for link in self._fanout_links():
             self._send(link, frame)
         self._last_state = state
         if self._draining:
@@ -659,14 +806,19 @@ class ShardSupervisor:
         # snapshot stall backstop: a worker that stopped draining its
         # attach snapshot is wedged — kill it and let respawn + a fresh
         # snapshot do its job
-        for link in list(self.links.values()):
+        for link in self._fanout_links():
             if (link.snap_queue is not None and not link.closed
                     and now - link.snap_started > self.SNAP_STALL_S):
                 self.log.error("shard %d: snapshot stalled %.0fs; "
                                "killing for respawn", link.shard,
                                now - link.snap_started)
-                self.kill_shard(link.shard)
+                self._kill_link(link)
         for i in range(self.n):
+            if i in self._roll_links:
+                # the roll cycle owns this shard's lifecycle: the
+                # incumbent may exit (drain) or the replacement may
+                # die (abort) without the respawn path interfering
+                continue
             link = self.links.get(i)
             if link is not None and link.proc.poll() is None:
                 continue
@@ -718,6 +870,195 @@ class ShardSupervisor:
                          link.shard, sig, pid)
         return pid
 
+    # -- zero-downtime rolling operations (SIGHUP / chaos worker-roll) --
+
+    def request_roll(self, reload_config: bool = False,
+                     shard: int = -1) -> Optional[asyncio.Task]:
+        """Sync entry point (signal handler, chaos driver): schedule a
+        roll of one shard (``shard >= 0``) or the whole group.  A roll
+        already in progress absorbs the request — two interleaved
+        rolls would race promotions for the same shard slot.  Busy is
+        marked HERE, synchronously: a double SIGHUP arrives before the
+        scheduled coroutine gets its first tick."""
+        if self._roll_busy or self._draining or self._loop is None:
+            self.log.warning("rolling upgrade already in progress or "
+                             "draining; request ignored")
+            return None
+        self._roll_busy = True
+        if shard >= 0:
+            return self._loop.create_task(self._roll_one(shard))
+        return self._loop.create_task(
+            self.roll_all(reload_config=reload_config))
+
+    async def _roll_one(self, shard: int) -> bool:
+        self._roll_busy = True
+        try:
+            return await self.roll_shard(shard)
+        finally:
+            self._roll_busy = False
+
+    async def roll_all(self, reload_config: bool = False) -> bool:
+        """The zero-downtime rolling operation: one shard at a time —
+        spawn replacement, snapshot catch-up, reuseport join, drain
+        the incumbent — stopping at the FIRST failed step (a bad
+        config or build aborts with every remaining shard untouched
+        and still serving)."""
+        self._roll_busy = True
+        try:
+            if reload_config:
+                self._reload_options()
+            for i in range(self.n):
+                if self._draining:
+                    return False
+                if not await self.roll_shard(i):
+                    self.log.error(
+                        "rolling upgrade stopped at shard %d; %d "
+                        "shard(s) still on the previous incarnation",
+                        i, self.n - i)
+                    return False
+            self.log.info("rolling upgrade complete (%d shard(s))",
+                          self.n)
+            return True
+        finally:
+            self._roll_busy = False
+
+    def _reload_options(self) -> bool:
+        """Config-reload half of SIGHUP: re-read the config file so
+        every subsequent spawn — the roll cycle's replacements first —
+        sees the fresh config.  The resolved port, host, and shard
+        count are pinned: a reload must never re-draw the reuseport
+        group out from under connected clients.  A malformed file
+        rolls with the previous config (and says so) — the roll's
+        process-replacement half still applies code updates."""
+        path = self.options.get("configFile")
+        if not path:
+            # direct-options deployments (tests, embedding) roll the
+            # processes with the current config
+            self._cfg_path = None
+            return False
+        try:
+            with open(str(path)) as f:
+                fresh = json.load(f)
+        except (OSError, ValueError) as e:
+            self.log.error("config reload from %s failed (%s); "
+                           "rolling with the previous config", path, e)
+            return False
+        fresh["configFile"] = path
+        fresh["shards"] = self.n
+        fresh["host"] = self.host
+        fresh["port"] = self.port
+        self.options = fresh
+        self._cfg_path = None
+        self.log.info("config reloaded from %s", path)
+        return True
+
+    async def roll_shard(self, i: int) -> bool:
+        """One drain-and-replace step.  The incumbent keeps serving
+        until the replacement has (1) replayed the attach snapshot,
+        (2) reported hello — its SO_REUSEPORT sockets are bound, the
+        kernel is already splitting load across both incarnations —
+        and (3) reported a ready replica over the stats feed.  Only
+        then does the incumbent get SIGTERM, quiesce (serve out
+        in-flight), and exit.  Every phase is a ``rolling-upgrade``
+        flight event; failure to converge aborts with the incumbent
+        untouched."""
+        if self.udp_port is None or i in self._roll_links \
+                or not 0 <= i < self.n:
+            return False
+        old = self.links.get(i)
+        old_pid = old.proc.pid if old is not None else None
+        self._rolling_shard = i
+        if self.recorder is not None:
+            self.recorder.record("rolling-upgrade", phase="spawn",
+                                 shard=i, old_pid=old_pid)
+        repl = self._spawn_link(i, self.udp_port, role="replacement")
+        self._roll_links[i] = repl
+        try:
+            reason = None
+            try:
+                await self._wait_hello(i, timeout=ROLL_CONVERGE_S,
+                                       link=repl)
+            except asyncio.TimeoutError:
+                reason = f"no hello within {ROLL_CONVERGE_S:.0f}s"
+            if reason is None:
+                deadline = time.monotonic() + ROLL_CONVERGE_S
+                while True:
+                    if repl.closed or repl.proc.poll() is not None:
+                        reason = "replacement died during catch-up"
+                        break
+                    stats = repl.stats
+                    if stats is not None and stats.get("ready"):
+                        break
+                    if time.monotonic() >= deadline:
+                        reason = ("replica not ready within "
+                                  f"{ROLL_CONVERGE_S:.0f}s")
+                        break
+                    await asyncio.sleep(0.05)
+            if reason is not None:
+                self.roll_aborts += 1
+                self._m_roll_aborts.inc()
+                self.log.error("shard %d roll aborted: %s "
+                               "(incumbent pid %s keeps serving)",
+                               i, reason, old_pid)
+                if self.recorder is not None:
+                    self.recorder.record("rolling-upgrade",
+                                         phase="abort", shard=i,
+                                         reason=reason)
+                self._kill_link(repl)
+                try:
+                    repl.proc.wait(timeout=5)
+                except Exception:
+                    pass
+                return False
+            if self.recorder is not None:
+                self.recorder.record(
+                    "rolling-upgrade", phase="promote", shard=i,
+                    old_pid=old_pid, new_pid=repl.proc.pid,
+                    snapshot_frames=repl.snap_sent)
+            self.links[i] = repl
+            if old is not None:
+                await self._drain_incumbent(old)
+            self.rolls[i] += 1
+            self._roll_children[i].inc()
+            self.log.info("shard %d rolled: pid %s -> %d", i, old_pid,
+                          repl.proc.pid)
+            if self.recorder is not None:
+                self.recorder.record("rolling-upgrade", phase="done",
+                                     shard=i, old_pid=old_pid,
+                                     new_pid=repl.proc.pid)
+            return True
+        finally:
+            self._roll_links.pop(i, None)
+            self._rolling_shard = None
+
+    async def _drain_incumbent(self, link: ShardLink) -> None:
+        """SIGTERM the outgoing incarnation and wait bounded: the
+        worker quiesces (leaves the reuseport group, serves out its
+        in-flight queries) and exits clean; a straggler is KILLed at
+        the deadline."""
+        proc = link.proc
+        if proc.poll() is None:
+            try:
+                proc.terminate()
+            except (ProcessLookupError, OSError):
+                pass
+        deadline = time.monotonic() + ROLL_DRAIN_S
+        while proc.poll() is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if proc.poll() is None:
+            self.log.warning("shard %d: outgoing pid %d ignored the "
+                             "drain window; killing", link.shard,
+                             proc.pid)
+            try:
+                proc.kill()
+            except (ProcessLookupError, OSError):
+                pass
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+        self._close_link(link)
+
     async def drain(self, timeout: float = 10.0) -> None:
         """SIGTERM drain: stop respawning, TERM every worker, wait
         bounded, KILL stragglers, reap everything — no orphan PIDs."""
@@ -730,7 +1071,8 @@ class ShardSupervisor:
                 pass
             self._tick_task = None
         procs: List[subprocess.Popen] = []
-        for link in list(self.links.values()):
+        # mid-roll replacements are processes too — no orphan PIDs
+        for link in self._fanout_links():
             if link.proc.poll() is None:
                 try:
                     link.proc.terminate()
@@ -755,9 +1097,10 @@ class ShardSupervisor:
         # links close only AFTER the workers had their SIGTERM window:
         # closing first would race their graceful drain with the noisy
         # link-down exit path
-        for link in list(self.links.values()):
+        for link in self._fanout_links():
             self._close_link(link)
         self.links.clear()
+        self._roll_links.clear()
         if self._tmpdir is not None:
             shutil.rmtree(self._tmpdir, ignore_errors=True)
             self._tmpdir = None
@@ -787,6 +1130,7 @@ class ShardSupervisor:
                 "metrics_port": (hello.get("metrics_port")
                                  if hello else None),
                 "respawns": self.respawns[i],
+                "rolls": self.rolls[i],
                 "requests": self._requests_total.get(i, 0.0),
                 "generation": (stats or {}).get("gen", 0),
                 "epoch": (stats or {}).get("epoch", 0),
@@ -814,6 +1158,9 @@ class ShardSupervisor:
                 "udp_port": self.udp_port,
                 "tcp_port": self.tcp_port,
                 "respawns_total": sum(self.respawns.values()),
+                "rolls_total": sum(self.rolls.values()),
+                "roll_aborts": self.roll_aborts,
+                "rolling_shard": self._rolling_shard,
                 "digest_checks": self.digest_checks,
                 "digest_violations": self.digest_violations,
                 "workers": workers,

@@ -467,5 +467,231 @@ class TestChaosShardKill:
         assert [a for _, a in driver.applied] == ["shard-kill"]
 
 
+class TestChaosRollAndFlood:
+    """ISSUE 19 satellite: the ``worker-roll`` and ``rrl-flood`` chaos
+    actions parse and dispatch (the live end-to-end exercise is
+    ``tools/population_smoke.py`` phase B)."""
+
+    def test_worker_roll_parses_and_dispatches(self):
+        plan = FaultPlan.parse("at 0.5 worker-roll shard=1\n"
+                               "at 1.0 worker-roll")
+        assert [(t, a) for t, a, _ in plan.timeline] == \
+            [(0.5, "worker-roll"), (1.0, "worker-roll")]
+        rolled = []
+        driver = ChaosDriver(plan, roll_target=rolled.append)
+        driver.apply("worker-roll", {"shard": 1})
+        driver.apply("worker-roll", {})
+        assert rolled == [1, -1]
+
+    def test_rrl_flood_sends_from_hostile_prefixes(self):
+        """rrl-flood binds real sockets in the hostile /24s and fires
+        decodable queries at the UDP target — the same source prefixes
+        tools/hostile.py floods from, so RRL judges them alike."""
+        from binder_tpu.chaos.plan import FLOOD_PREFIXES
+        recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        recv.bind(("127.0.0.1", 0))
+        recv.settimeout(2.0)
+        try:
+            port = recv.getsockname()[1]
+            driver = ChaosDriver(
+                FaultPlan(),
+                udp_target=("127.0.0.1", port, f"w0.{DOMAIN}"))
+            driver.apply("rrl-flood", {"n": 32})
+            srcs, data = set(), b""
+            for _ in range(32):
+                data, addr = recv.recvfrom(4096)
+                srcs.add(addr[0].rsplit(".", 1)[0])
+            # flood traffic really arrives FROM the hostile prefixes
+            assert srcs <= set(FLOOD_PREFIXES) and len(srcs) >= 2
+            msg = Message.decode(data)
+            assert msg.questions[0].name == f"w0.{DOMAIN}"
+        finally:
+            recv.close()
+
+    def test_no_target_is_skipped_not_fatal(self):
+        driver = ChaosDriver(FaultPlan())
+        driver.apply("worker-roll", {})            # must not raise
+        driver.apply("rrl-flood", {"n": 4})        # must not raise
+        assert [a for _, a in driver.applied] == \
+            ["worker-roll", "rrl-flood"]
+
+
+class TestRollingOps:
+    """ISSUE 19 tentpole: zero-downtime drain-and-replace.  The
+    incumbent keeps serving until the replacement is snapshot-caught-up
+    and reuseport-bound; only then is it drained."""
+
+    def test_roll_shard_drain_and_replace(self, tmp_path):
+        async def run():
+            sup = await boot(str(tmp_path), 2)
+            try:
+                port = sup.udp_port
+                pid0 = sup._pid(0)
+                old_proc = sup.links[0].proc
+                assert await sup.roll_shard(0)
+                assert sup._pid(0) not in (None, pid0)
+                # the incumbent exited AND was reaped
+                assert old_proc.poll() is not None
+                assert sup.rolls[0] == 1 and sup.roll_aborts == 0
+                assert sup.respawns[0] == 0     # a roll is not a crash
+                snap = sup.snapshot()
+                assert snap["shards"]["rolls_total"] == 1
+                assert snap["shards"]["rolling_shard"] is None
+                # a mutation AFTER the roll: the replacement's delta
+                # feed is live, not just its snapshot
+                sup.store.put_json(
+                    "/test/shard/w1",
+                    {"type": "host", "host": {"address": "10.50.7.7"}})
+
+                async def converged():
+                    for s in range(8):
+                        data = await ask_fresh(port, f"w1.{DOMAIN}",
+                                               Type.A, qid=700 + s)
+                        msg = Message.decode(data)
+                        if not msg.answers or \
+                                msg.answers[0].address != "10.50.7.7":
+                            return False
+                    return True
+
+                deadline = time.monotonic() + 10
+                while not await converged():
+                    assert time.monotonic() < deadline, \
+                        "rolled group never converged on the " \
+                        "post-roll mutation"
+                    await asyncio.sleep(0.2)
+            finally:
+                await sup.drain()
+
+        asyncio.run(run())
+
+    def test_request_roll_group_and_busy_absorbed(self, tmp_path):
+        async def run():
+            sup = await boot(str(tmp_path), 2)
+            try:
+                pids = {i: sup._pid(i) for i in range(2)}
+                task = sup.request_roll()
+                assert task is not None
+                # an overlapping request is absorbed, not interleaved
+                # (two rolls racing promotions for one shard slot)
+                assert sup.request_roll() is None
+                assert await task
+                for i in range(2):
+                    assert sup._pid(i) not in (None, pids[i])
+                assert sup.rolls == {0: 1, 1: 1}
+                assert sup.roll_aborts == 0
+                # answers still flow from the new incarnation
+                data = await ask_fresh(sup.udp_port, f"w0.{DOMAIN}",
+                                       Type.A, qid=41)
+                assert Message.decode(data).answers
+            finally:
+                await sup.drain()
+
+        asyncio.run(run())
+
+    def test_roll_abort_keeps_incumbent_serving(self, tmp_path):
+        """A replacement that never reports hello aborts the roll with
+        the incumbent untouched — a bad build or config must not take
+        down a serving shard."""
+        async def run():
+            sup = await boot(str(tmp_path), 1)
+            try:
+                pid0 = sup._pid(0)
+
+                async def no_hello(i, timeout=0.0, link=None):
+                    raise asyncio.TimeoutError
+
+                sup._wait_hello = no_hello
+                assert not await sup.roll_shard(0)
+                assert sup.roll_aborts == 1 and sup.rolls[0] == 0
+                assert sup._pid(0) == pid0
+                data = await ask_fresh(sup.udp_port, f"w0.{DOMAIN}",
+                                       Type.A, qid=51)
+                assert Message.decode(data).answers
+            finally:
+                await sup.drain()
+
+        asyncio.run(run())
+
+
+class TestDcsFanout:
+    """ISSUE 19 satellite: the ``/dcs`` subtree fans through the
+    owner->worker mutation log (``pnode``/``pgone`` frames), so a
+    worker's DcRegistry sees membership changes that happen AFTER it
+    attached — pre-attach state rides the snapshot, post-attach joins
+    and leaves ride the delta feed."""
+
+    def test_worker_sees_dc_join_after_attach(self):
+        from binder_tpu.federation.registry import DcRegistry
+        from binder_tpu.metrics.collector import MetricsCollector
+        from binder_tpu.shard import ReplicaStore
+        from binder_tpu.shard.supervisor import ShardLink, ShardSupervisor
+        from binder_tpu.store import FakeStore, MirrorCache
+
+        async def run():
+            store = FakeStore()
+            for path, data in FIXTURE.items():
+                store.put_json(path, data)
+            # dc1 joins BEFORE the worker attaches: snapshot path
+            store.put_json("/dcs/dc1", {"zones": ["east"],
+                                        "peers": ["10.9.9.1:53"]})
+            cache = MirrorCache(store, DOMAIN)
+            store.start_session()
+
+            sup = ShardSupervisor(
+                options={"shards": 1, "host": "127.0.0.1", "port": 0,
+                         "dnsDomain": DOMAIN},
+                store=store, cache=cache, collector=MetricsCollector())
+            loop = asyncio.get_running_loop()
+            sup._loop = loop
+            sup_end, worker_end = socket.socketpair()
+            sup_end.setblocking(False)
+            link = ShardLink(0, _StubProc(), sup_end)
+            sup.links[0] = link
+            sup._send_snapshot(link)
+            replica = ReplicaStore(worker_end, 0)
+            fut = loop.run_in_executor(None, replica.read_snapshot, 30.0)
+            while not fut.done():
+                sup._tick()
+                await asyncio.sleep(0.02)
+            await fut
+
+            # the worker's registry comes up with the pre-attach
+            # membership — delivered by the snapshot, not a store read
+            reg = DcRegistry(replica, self_name="dc0")
+            reg.start()
+            assert set(reg.records) == {"dc1"}
+            assert reg.records["dc1"]["zones"] == ["east"]
+            changes = []
+            reg.on_change(lambda: changes.append(dict(reg.records)))
+
+            replica.start(loop)     # non-blocking delta feed
+
+            # a DC that joins AFTER attach must reach the worker
+            store.put_json("/dcs/dc2", {"zones": ["west"],
+                                        "peers": ["10.9.9.2:53"]})
+            deadline = time.monotonic() + 5
+            while "dc2" not in reg.records:
+                assert time.monotonic() < deadline, \
+                    "post-attach dc-join never reached the worker"
+                await asyncio.sleep(0.02)
+            assert reg.records["dc2"]["peers"] == ["10.9.9.2:53"]
+            assert reg.joins >= 1 and changes
+
+            # ... and so must a leave (pgone -> children watch fires)
+            store.rmr("/dcs/dc2")
+            deadline = time.monotonic() + 5
+            while "dc2" in reg.records:
+                assert time.monotonic() < deadline, \
+                    "post-attach dc-leave never reached the worker"
+                await asyncio.sleep(0.02)
+            assert reg.leaves >= 1
+            assert set(reg.records) == {"dc1"}
+
+            replica.close()
+            sup._close_link(link)
+
+        asyncio.run(run())
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))

@@ -102,7 +102,13 @@ async def _run(duration: float) -> dict:
         flight_recorder=recorder, recursion=recursion,
         degradation={"maxStalenessSeconds": max_staleness,
                      "staleTtlClampSeconds": 5},
-        admission={"maxInflight": 128})
+        admission={"maxInflight": 128},
+        # RRL v2 mid-incident posture: the measurement client's /24 is
+        # allowlisted (pre-decode, never limited) so the scripted
+        # rrl-flood clamps ONLY the attacker prefixes while every
+        # invariant below keeps being asserted through the flood
+        rrl={"responsesPerSecond": 20, "burst": 40,
+             "allowlist": ["127.0.0.0/24"]})
     await server.start()
     intro = Introspector(server=server, recorder=recorder,
                          collector=collector, name="chaos-smoke")
@@ -117,6 +123,7 @@ async def _run(duration: float) -> dict:
         .at(duration * 0.35, "tcp-half-close", queries=2) \
         .at(duration * 0.40, "tcp-rst", conns=2) \
         .at(duration * 0.45, "loop-stall", ms=120) \
+        .at(duration * 0.50, "rrl-flood", n=400) \
         .at(duration * 0.65, "restore-session") \
         .at(duration * 0.70, "upstream", clear=True)
     plan.upstream = up_plan.upstream   # faults act on the live upstream
@@ -128,6 +135,8 @@ async def _run(duration: float) -> dict:
 
     driver = ChaosDriver(plan, store=store, mutate=mutate,
                          tcp_target=("127.0.0.1", server.tcp_port,
+                                     f"w0.{DOMAIN}"),
+                         udp_target=("127.0.0.1", server.udp_port,
                                      f"w0.{DOMAIN}"),
                          recorder=recorder)
     chaos_task = driver.start()
@@ -223,6 +232,19 @@ async def _run(duration: float) -> dict:
         errs = validate_degradation_metrics(collector.expose())
         if errs:
             raise Violation(f"degradation metrics: {errs[:3]}")
+        # rrl-flood engagement: the spoofed burst must have been
+        # limited (dropped or slipped), and the measurement client's
+        # allowlisted /24 must have bypassed RRL entirely — the flood
+        # ran mid-incident, so the invariants above already prove
+        # serving survived it
+        rrl = server._rrl
+        if rrl.dropped + rrl.slipped == 0:
+            raise Violation("rrl-flood was never rate-limited")
+        if rrl.allowlisted == 0:
+            raise Violation("allowlisted measurement prefix never "
+                            "bypassed RRL")
+        stats["rrl"] = {"dropped": rrl.dropped, "slipped": rrl.slipped,
+                        "allowlisted": rrl.allowlisted}
         stats["tcp"] = tcp_stats.snapshot()
         stats["flight_events"] = dict(recorder.by_type)
         stats["shed"] = dict(server._admission.shed_counts)

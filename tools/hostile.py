@@ -423,6 +423,7 @@ def blast(host: str, port: int, *, duration: float = 10.0,
         row["dropped"] = max(0, row["sent"] - row["answered"]
                              - row["refused"] - row["formerr"]
                              - row["slipped"])
+    prefixes = len({fl.src[0].rsplit(".", 1)[0] for fl in flow_objs})
     for fl in flow_objs:
         sel.unregister(fl.sock)
         fl.close()
@@ -433,6 +434,11 @@ def blast(host: str, port: int, *, duration: float = 10.0,
         "mix": {c: round(w, 4) for c, w in zip(CATEGORIES, weights)},
         "hostile_qps": round(sent_total / elapsed, 1) if elapsed else 0.0,
         "sent": sent_total,
+        # population shape (same keys tools/population.py exports, so
+        # consumers can describe ANY harness run uniformly): hostile
+        # flows are one identity per socket, uniform name draw, no NAT
+        "population": {"identities": flows, "prefixes": prefixes,
+                       "zipf_s": None, "nat_fan_in": 1},
         "categories": report,
     }
 

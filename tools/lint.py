@@ -627,7 +627,10 @@ def validate_status_snapshot(snap):
         if isinstance(rrl, dict):
             for key in ("enabled", "responses_per_second", "burst",
                         "slip_ratio", "buckets", "hot", "responses",
-                        "slipped", "dropped", "evictions"):
+                        "slipped", "dropped", "evictions",
+                        "allowlist", "allowlisted", "adaptive",
+                        "adapted_buckets", "adaptations",
+                        "false_positives"):
                 if key not in rrl:
                     errs.append(f"policy.rrl: missing {key!r}")
     return errs
@@ -819,6 +822,8 @@ _SHARD_FAMILIES = {
     "binder_shard_ready": ("gauge", True),
     "binder_shard_respawns": ("counter", True),
     "binder_shard_requests": ("counter", True),
+    "binder_shard_rolls_total": ("counter", True),
+    "binder_shard_roll_aborts_total": ("counter", False),
 }
 
 
@@ -1007,8 +1012,12 @@ _RRL_FAMILIES = {
     "binder_rrl_slipped_total": "counter",
     "binder_rrl_dropped_total": "counter",
     "binder_rrl_evictions_total": "counter",
+    "binder_rrl_allowlisted_total": "counter",
+    "binder_rrl_adaptations_total": "counter",
+    "binder_rrl_false_positives_total": "counter",
     "binder_rrl_buckets": "gauge",
     "binder_rrl_active": "gauge",
+    "binder_rrl_adapted_buckets": "gauge",
 }
 
 
